@@ -1,0 +1,22 @@
+"""examl_tpu — TPU-native maximum-likelihood phylogenetic inference.
+
+A ground-up JAX/XLA re-design of the capabilities of stamatak/ExaML
+(Felsenstein-pruning likelihood, RAxML SPR search, GTR-family models with
+GAMMA / per-site-rate heterogeneity, model optimization, checkpointing).
+
+Architecture (TPU-first, not a port):
+  - Alignment sites are pattern-compressed, packed into 128-lane blocks and
+    sharded over a `jax.sharding.Mesh` ("data parallelism over sites", the
+    reference's one distributed strategy — ExaML `partitionAssignment.c`).
+  - Conditional likelihood vectors (CLVs) live in one HBM-resident tensor
+    `[nodes, blocks, lane, rates, states]`; tree traversals execute as a
+    `lax.scan` over a fixed-size traversal descriptor.
+  - The per-lnL MPI_Allreduce of the reference (ExaML
+    `evaluateGenericSpecial.c:968`) becomes a `psum` over the mesh.
+  - Tree topology bookkeeping, SPR moves and scalar optimizer control loops
+    stay on the host, mirroring the reference's split.
+"""
+
+__version__ = "0.1.0"
+
+from examl_tpu import constants  # noqa: F401

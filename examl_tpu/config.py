@@ -1,0 +1,15 @@
+"""Runtime configuration helpers."""
+
+from __future__ import annotations
+
+import jax
+
+
+def enable_x64() -> None:
+    """Enable float64 in JAX (required for dtype=float64 engines).
+
+    The reference computes in double precision throughout; call this before
+    building engines when bit-comparable lnL values are wanted.  float32
+    engines (with the 2^-64 rescaling threshold) work without it.
+    """
+    jax.config.update("jax_enable_x64", True)

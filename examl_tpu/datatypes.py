@@ -1,0 +1,127 @@
+"""Data-type definitions: state spaces and ambiguity-code encodings.
+
+Each alignment character is encoded as a small integer code; a code maps to a
+bitmask over the concrete states (ambiguity codes set several bits, gaps set
+all bits).  The tip likelihood vector of a code is the 0/1 indicator of its
+set bits in the probability basis.
+
+Mirrors the semantics of the reference's meaning tables
+(ExaML `globalVariables.h:62-130`, `parser/axml.c` input encoding); the
+IUPAC nucleotide / amino-acid ambiguity assignments are public standards.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+DNA_DATA = "DNA"
+AA_DATA = "AA"
+BINARY_DATA = "BIN"
+
+
+@dataclass(frozen=True)
+class DataType:
+    name: str
+    states: int                 # concrete state count (DNA 4, AA 20, BIN 2)
+    code_bitmasks: np.ndarray   # [num_codes] uint32 bitmask per code
+    char_to_code: dict          # alignment character -> code
+    undetermined_code: int      # the all-states code (gap/N/X/?)
+    gamma_rates: int = 4
+
+    @property
+    def num_codes(self) -> int:
+        return len(self.code_bitmasks)
+
+    def tip_indicator_table(self) -> np.ndarray:
+        """[num_codes, states] 0/1 tip likelihood vectors (probability basis)."""
+        table = np.zeros((self.num_codes, self.states))
+        for code, mask in enumerate(self.code_bitmasks):
+            for s in range(self.states):
+                if (int(mask) >> s) & 1:
+                    table[code, s] = 1.0
+        return table
+
+    def encode(self, seq: str) -> np.ndarray:
+        """Encode an alignment row into codes (uint8), vectorized."""
+        lut = _encode_lut(self)
+        raw = np.frombuffer(seq.encode("ascii"), dtype=np.uint8)
+        out = lut[raw]
+        if (out == _BAD).any():
+            i = int(np.argmax(out == _BAD))
+            raise ValueError(
+                f"bad {self.name} character {seq[i]!r} at column {i}")
+        return out
+
+
+_BAD = np.uint8(255)
+_LUT_CACHE: dict = {}
+
+
+def _encode_lut(dt: "DataType") -> np.ndarray:
+    """256-entry byte -> code table (upper+lowercase), 255 = invalid."""
+    lut = _LUT_CACHE.get(dt.name)
+    if lut is None:
+        lut = np.full(256, _BAD, dtype=np.uint8)
+        for ch, code in dt.char_to_code.items():
+            lut[ord(ch)] = code
+            lut[ord(ch.lower())] = code
+        _LUT_CACHE[dt.name] = lut
+    return lut
+
+
+def _dna() -> DataType:
+    # Bit order A=1, C=2, G=4, T=8 (IUPAC).
+    mask_of = {
+        "A": 1, "C": 2, "G": 4, "T": 8, "U": 8,
+        "M": 3, "R": 5, "W": 9, "S": 6, "Y": 10, "K": 12,
+        "V": 7, "H": 11, "D": 13, "B": 14,
+        "N": 15, "O": 15, "X": 15, "-": 15, "?": 15,
+    }
+    # Code == bitmask value (16 codes, 0 unused), as in the reference layout.
+    masks = np.arange(16, dtype=np.uint32)
+    char_to_code = {ch: int(m) for ch, m in mask_of.items()}
+    return DataType(DNA_DATA, 4, masks, char_to_code, undetermined_code=15)
+
+
+_AA_ORDER = "ARNDCQEGHILKMFPSTWYV"  # standard 20-state ordering
+
+
+def _aa() -> DataType:
+    # Codes 0..19 concrete, 20=B (D or N), 21=Z (E or Q), 22=X/-/?/* (all).
+    masks = np.zeros(23, dtype=np.uint32)
+    char_to_code = {}
+    for i, ch in enumerate(_AA_ORDER):
+        masks[i] = np.uint32(1 << i)
+        char_to_code[ch] = i
+    d, n = _AA_ORDER.index("D"), _AA_ORDER.index("N")
+    e, q = _AA_ORDER.index("E"), _AA_ORDER.index("Q")
+    masks[20] = np.uint32((1 << d) | (1 << n))
+    masks[21] = np.uint32((1 << e) | (1 << q))
+    masks[22] = np.uint32((1 << 20) - 1)
+    char_to_code.update({"B": 20, "Z": 21})
+    for ch in "X-?*J":
+        char_to_code[ch] = 22
+    return DataType(AA_DATA, 20, masks, char_to_code, undetermined_code=22)
+
+
+def _binary() -> DataType:
+    masks = np.array([0, 1, 2, 3], dtype=np.uint32)
+    char_to_code = {"0": 1, "1": 2, "-": 3, "?": 3}
+    return DataType(BINARY_DATA, 2, masks, char_to_code, undetermined_code=3)
+
+
+DNA = _dna()
+AA = _aa()
+BINARY = _binary()
+
+BY_NAME = {DNA_DATA: DNA, AA_DATA: AA, BINARY_DATA: BINARY,
+           "PROT": AA, "BINARY": BINARY}
+
+
+def get(name: str) -> DataType:
+    try:
+        return BY_NAME[name.upper()]
+    except KeyError:
+        raise ValueError(f"unknown data type {name!r}")

@@ -1,0 +1,202 @@
+"""PhyloInstance: alignment + models + device engines behind one facade.
+
+The host-side counterpart of the reference's `tree` master struct plus its
+generic entry points (`evaluateGeneric`, `newviewGeneric`,
+`makenewzGeneric` — ExaML `axml.h:1223-1256`): owns per-partition model
+parameters, the packed site buckets (one device program per state count),
+and the CLV orientation bookkeeping against a host `Tree`.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from examl_tpu.io.alignment import AlignmentData
+from examl_tpu.models import protein as protein_mod
+from examl_tpu.models.gtr import ModelParams, build_model
+from examl_tpu.ops.engine import LikelihoodEngine
+from examl_tpu.parallel.packing import pack_partitions
+from examl_tpu.tree.topology import Node, Tree, TraversalEntry
+
+
+class PhyloInstance:
+    def __init__(self, alignment: AlignmentData, dtype=jnp.float64,
+                 ncat: int = 4, use_median: bool = False,
+                 per_partition_branches: bool = False,
+                 block_multiple: int = 1, sharding=None):
+        self.alignment = alignment
+        self.dtype = jnp.dtype(dtype)
+        self.ncat = ncat
+        self.use_median = use_median
+        M = len(alignment.partitions)
+        self.num_parts = M
+        self.per_partition_branches = per_partition_branches
+        self.num_branch_slots = M if per_partition_branches else 1
+
+        # Initial models (reference initModel `models.c:4180`): GTR rates all
+        # 1.0, empirical frequencies (or the protein matrix's own), alpha 1.0.
+        self.models: List[ModelParams] = []
+        for part in alignment.partitions:
+            rates, freqs = None, part.empirical_freqs
+            if part.datatype.name == "AA" and part.model_name != "GTR":
+                rates, model_freqs = protein_mod.get_matrix(part.model_name)
+                if not part.use_empirical_freqs and not part.optimize_freqs:
+                    freqs = model_freqs
+            self.models.append(build_model(
+                part.datatype, freqs, rates=rates, alpha=1.0, ncat=ncat,
+                use_median=use_median))
+
+        self.buckets = pack_partitions(alignment.partitions,
+                                       block_multiple=block_multiple)
+        self.engines: Dict[int, LikelihoodEngine] = {}
+        for states, bucket in self.buckets.items():
+            branch_indices = ([bucket.part_ids[i] for i in range(bucket.num_parts)]
+                              if per_partition_branches
+                              else [0] * bucket.num_parts)
+            self.engines[states] = LikelihoodEngine(
+                bucket, [self.models[g] for g in bucket.part_ids],
+                alignment.ntaxa, num_branch_slots=self.num_branch_slots,
+                branch_indices=branch_indices, dtype=dtype, sharding=sharding)
+
+        self.per_partition_lnl = np.full(M, np.nan)
+        self.likelihood = np.nan
+        # Smoothing state (reference partitionSmoothed/partitionConverged).
+        self.partition_smoothed = np.zeros(self.num_branch_slots, dtype=bool)
+        self.partition_converged = np.zeros(self.num_branch_slots, dtype=bool)
+
+    # -- model push --------------------------------------------------------
+
+    def push_models(self) -> None:
+        for states, bucket in self.buckets.items():
+            self.engines[states].set_models(
+                [self.models[g] for g in bucket.part_ids])
+
+    def set_model(self, gid: int, model: ModelParams, push: bool = True) -> None:
+        self.models[gid] = model
+        if push:
+            self.push_models()
+
+    # -- tree construction -------------------------------------------------
+
+    def tree_from_newick(self, text: str) -> Tree:
+        return Tree.from_newick(text, self.alignment.taxon_names,
+                                self.num_branch_slots)
+
+    def random_tree(self, seed: int = 0) -> Tree:
+        return Tree.random(self.alignment.taxon_names, seed,
+                           self.num_branch_slots)
+
+    # -- CLV orientation / traversal ---------------------------------------
+
+    def _collect(self, tree: Tree, slot: Node, full: bool) -> List[TraversalEntry]:
+        if tree.is_tip(slot.number):
+            return []
+        return tree.compute_traversal(slot, full)
+
+    def new_view(self, tree: Tree, slot: Node) -> None:
+        """Make slot's CLV valid (reference newviewGeneric)."""
+        entries = self._collect(tree, slot, full=False)
+        self.run_traversal(entries)
+
+    def run_traversal(self, entries: List[TraversalEntry]) -> None:
+        if not entries:
+            return
+        for eng in self.engines.values():
+            eng.run_traversal(entries)
+
+    # -- likelihood --------------------------------------------------------
+
+    def evaluate(self, tree: Tree, p: Optional[Node] = None,
+                 full: bool = False) -> float:
+        """lnL at branch (p, p.back); reference evaluateGeneric
+        (`evaluateGenericSpecial.c:897-1001`)."""
+        if p is None:
+            p = tree.start
+        q = p.back
+        if full:
+            tree.invalidate_all()
+        entries = self._collect(tree, p, full) + self._collect(tree, q, full)
+        self.run_traversal(entries)
+        per_part = np.zeros(self.num_parts)
+        for states, eng in self.engines.items():
+            vals = eng.evaluate(p.number, q.number, p.z)
+            for li, gid in enumerate(eng.bucket.part_ids):
+                per_part[gid] = vals[li]
+        self.per_partition_lnl = per_part
+        self.likelihood = float(per_part.sum())
+        return self.likelihood
+
+    # -- branch-length optimization (Newton-Raphson) ------------------------
+
+    def makenewz(self, tree: Tree, p: Node, q: Node, z0: Sequence[float],
+                 maxiter: int = 1, mask_converged: bool = False) -> np.ndarray:
+        """Optimize the branch (p,q) starting from z0; returns new z [C].
+
+        Mirrors reference `topLevelMakenewz`
+        (`makenewzGenericSpecial.c:1133-1349`) including curvature guards.
+        """
+        from examl_tpu.constants import ZMAX, ZMIN
+
+        self.new_view(tree, p)
+        self.new_view(tree, q)
+        sts = {s: eng.make_sumtable(p.number, q.number)
+               for s, eng in self.engines.items()}
+
+        C = self.num_branch_slots
+        z = np.asarray(z0, dtype=np.float64).copy()
+        zprev = z.copy()
+        zstep = np.zeros(C)
+        maxiters = np.full(C, maxiter)
+        outer_conv = np.zeros(C, dtype=bool)
+        curvat_ok = np.ones(C, dtype=bool)
+        if mask_converged:
+            outer_conv |= self.partition_converged
+
+        while not outer_conv.all():
+            fresh = ~outer_conv & curvat_ok
+            zprev = np.where(fresh, z, zprev)
+            zstep = np.where(fresh, (1.0 - ZMAX) * z + ZMIN, zstep)
+            curvat_ok = np.where(fresh, False, curvat_ok)
+
+            z = np.clip(z, ZMIN, ZMAX)
+            d1 = np.zeros(C)
+            d2 = np.zeros(C)
+            for s, eng in self.engines.items():
+                e1, e2 = eng.branch_derivatives(sts[s], z)
+                d1 += e1
+                d2 += e2
+
+            active = ~outer_conv & ~curvat_ok
+            bad = active & (d2 >= 0.0) & (z < ZMAX)
+            z = np.where(bad, 0.37 * z + 0.63, z)
+            zprev = np.where(bad, z, zprev)
+            curvat_ok = np.where(active & ~bad, True, curvat_ok)
+
+            step = curvat_ok & ~outer_conv
+            if step.any():
+                with np.errstate(over="ignore"):
+                    tantmp = np.where(d2 < 0.0, -d1 / np.where(d2 < 0, d2, 1.0),
+                                      np.inf)
+                    znew = np.where(tantmp < 100.0,
+                                    np.clip(z * np.exp(np.minimum(tantmp, 100.0)),
+                                            ZMIN, None),
+                                    0.25 * zprev + 0.75)
+                    znew = np.minimum(znew, 0.25 * zprev + 0.75)
+                z = np.where(step & (d2 < 0.0), znew, z)
+                z = np.minimum(z, ZMAX)
+                maxiters = np.where(step, maxiters - 1, maxiters)
+                moving = np.abs(z - zprev) > zstep
+                gave_up = moving & (maxiters < -20)
+                z = np.where(step & gave_up, np.asarray(z0), z)
+                outer_conv = np.where(step, ~moving | gave_up, outer_conv)
+        return z
+
+
+def default_instance(phylip_path: str, model_path: Optional[str] = None,
+                     **kwargs) -> PhyloInstance:
+    from examl_tpu.io.alignment import load_alignment
+    ad = load_alignment(phylip_path, model_path)
+    return PhyloInstance(ad, **kwargs)

@@ -1,0 +1,3 @@
+from examl_tpu.io.phylip import read_phylip  # noqa: F401
+from examl_tpu.io.partitions import parse_partition_file, PartitionSpec  # noqa: F401
+from examl_tpu.io.alignment import AlignmentData, PartitionData, build_alignment_data  # noqa: F401

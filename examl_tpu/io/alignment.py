@@ -1,0 +1,144 @@
+"""Alignment preprocessing: encoding, pattern compression, empirical freqs.
+
+Equivalent role to the reference's offline parser pipeline
+(`parser/axml.c`: `sitesort`/`sitecombcrunch` pattern compression :1421-1675,
+`baseFrequenciesGTR` :2617, undetermined-column removal), re-expressed with
+array ops.  Pattern order within a partition is canonical-sorted rather than
+qsort-stable; order never affects the likelihood.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Sequence
+
+import numpy as np
+
+from examl_tpu import datatypes
+from examl_tpu.datatypes import DataType
+from examl_tpu.io.partitions import PartitionSpec, single_partition_spec
+from examl_tpu.io.phylip import read_phylip
+
+
+@dataclass
+class PartitionData:
+    """One partition after pattern compression."""
+    name: str
+    datatype: DataType
+    model_name: str
+    patterns: np.ndarray          # [ntaxa, npatterns] uint8 codes
+    weights: np.ndarray           # [npatterns] int64 pattern multiplicities
+    empirical_freqs: np.ndarray   # [states]
+    use_empirical_freqs: bool
+    optimize_freqs: bool
+    lg4: bool = False
+    auto: bool = False
+    branch_index: int = 0
+
+    @property
+    def width(self) -> int:
+        return self.patterns.shape[1]
+
+    @property
+    def states(self) -> int:
+        return self.datatype.states
+
+
+@dataclass
+class AlignmentData:
+    taxon_names: List[str]
+    partitions: List[PartitionData]
+
+    @property
+    def ntaxa(self) -> int:
+        return len(self.taxon_names)
+
+    @property
+    def total_patterns(self) -> int:
+        return sum(p.width for p in self.partitions)
+
+
+def compress_patterns(codes: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """Collapse duplicate columns of [ntaxa, width] into unique patterns + weights."""
+    cols = np.ascontiguousarray(codes.T)
+    uniq, counts = np.unique(cols, axis=0, return_counts=True)
+    return np.ascontiguousarray(uniq.T), counts.astype(np.int64)
+
+
+def empirical_frequencies(codes: np.ndarray, weights: np.ndarray,
+                          dt: DataType, smoothings: int = 32) -> np.ndarray:
+    """EM-style empirical state frequencies with ambiguity-code mass splitting
+    (same fixed-point iteration as reference `parser/axml.c:2331`)."""
+    table = dt.tip_indicator_table()            # [codes, states]
+    informative = table.sum(axis=1) < dt.states  # drop all-ambiguous chars
+    counts = np.zeros(dt.num_codes, dtype=np.float64)
+    w = np.broadcast_to(weights, codes.shape).reshape(-1).astype(np.float64)
+    np.add.at(counts, codes.reshape(-1), w)
+    counts = counts * informative
+    if counts.sum() == 0:
+        return np.full(dt.states, 1.0 / dt.states)
+    freqs = np.full(dt.states, 1.0 / dt.states)
+    for _ in range(smoothings):
+        mass = table * freqs                    # [codes, states]
+        norm = mass.sum(axis=1, keepdims=True)
+        norm[norm == 0.0] = 1.0
+        new = (counts[:, None] * mass / norm).sum(axis=0)
+        new /= new.sum()
+        if np.abs(new - freqs).max() < 1e-12:
+            freqs = new
+            break
+        freqs = new
+    return freqs
+
+
+def build_alignment_data(names: Sequence[str], sequences: Sequence[str],
+                         specs: Sequence[PartitionSpec] | None = None,
+                         datatype_name: str = "DNA",
+                         compress: bool = True) -> AlignmentData:
+    nsites = len(sequences[0])
+    if specs is None:
+        specs = [single_partition_spec(datatype_name, nsites)]
+    covered = np.concatenate([s.sites for s in specs])
+    if covered.max(initial=-1) >= nsites:
+        raise ValueError("partition range exceeds alignment length")
+    # Every column must be assigned (the reference parser errors likewise,
+    # parser/parsePartitions.c:642).
+    mask = np.zeros(nsites, dtype=bool)
+    mask[covered] = True
+    if not mask.all():
+        first = int(np.argmin(mask))
+        raise ValueError(
+            f"alignment position {first + 1} has not been assigned to any "
+            f"partition ({int((~mask).sum())} unassigned positions total)")
+
+    parts: List[PartitionData] = []
+    for spec in specs:
+        dt = datatypes.get(spec.datatype_name)
+        rows = [dt.encode(seq)[spec.sites] for seq in sequences]
+        codes = np.stack(rows)                          # [ntaxa, width]
+        # Drop columns where every taxon is fully undetermined
+        # (reference removes these before compression).
+        undet = (codes == dt.undetermined_code).all(axis=0)
+        codes = codes[:, ~undet]
+        if compress:
+            patterns, weights = compress_patterns(codes)
+        else:
+            patterns = codes
+            weights = np.ones(codes.shape[1], dtype=np.int64)
+        freqs = empirical_frequencies(patterns, weights, dt)
+        parts.append(PartitionData(
+            name=spec.name, datatype=dt, model_name=spec.model_name,
+            patterns=patterns, weights=weights, empirical_freqs=freqs,
+            use_empirical_freqs=spec.empirical_freqs,
+            optimize_freqs=spec.optimize_freqs, lg4=spec.lg4, auto=spec.auto,
+            branch_index=spec.branch_index))
+    return AlignmentData(list(names), parts)
+
+
+def load_alignment(phylip_path: str, model_path: str | None = None,
+                   datatype_name: str = "DNA",
+                   compress: bool = True) -> AlignmentData:
+    from examl_tpu.io.partitions import parse_partition_file
+    names, seqs = read_phylip(phylip_path)
+    specs = parse_partition_file(model_path) if model_path else None
+    return build_alignment_data(names, seqs, specs, datatype_name, compress)

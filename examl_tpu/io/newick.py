@@ -1,0 +1,113 @@
+"""Newick tree string read/write.
+
+Role of reference `treeIO.c` (`treeReadLen` :798, `Tree2String` :324), as a
+plain recursive-descent parser over an in-memory string.  Branch lengths in
+newick are expected substitutions per site t; internally branches are stored
+as z = exp(-t) like the reference.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+
+@dataclass
+class NewickNode:
+    name: Optional[str] = None
+    length: Optional[float] = None
+    children: List["NewickNode"] = field(default_factory=list)
+
+    @property
+    def is_leaf(self) -> bool:
+        return not self.children
+
+    def leaves(self):
+        if self.is_leaf:
+            yield self
+        else:
+            for c in self.children:
+                yield from c.leaves()
+
+
+class _Parser:
+    def __init__(self, text: str):
+        self.text = text.strip()
+        self.pos = 0
+
+    def peek(self) -> str:
+        return self.text[self.pos] if self.pos < len(self.text) else ""
+
+    def take(self) -> str:
+        ch = self.peek()
+        self.pos += 1
+        return ch
+
+    def parse(self) -> NewickNode:
+        node = self.parse_clade()
+        if self.peek() == ";":
+            self.take()
+        return node
+
+    def parse_clade(self) -> NewickNode:
+        node = NewickNode()
+        if self.peek() == "(":
+            self.take()
+            node.children.append(self.parse_clade())
+            while self.peek() == ",":
+                self.take()
+                node.children.append(self.parse_clade())
+            if self.take() != ")":
+                raise ValueError(f"newick: expected ')' at {self.pos}")
+        node.name = self.parse_label()
+        if self.peek() == ":":
+            self.take()
+            node.length = self.parse_number()
+        return node
+
+    def parse_label(self) -> Optional[str]:
+        if self.peek() == "'":
+            self.take()
+            out = []
+            while True:
+                ch = self.take()
+                if ch == "'":
+                    if self.peek() == "'":
+                        out.append(self.take())
+                    else:
+                        break
+                elif not ch:
+                    raise ValueError("newick: unterminated quoted label")
+                else:
+                    out.append(ch)
+            return "".join(out)
+        out = []
+        while self.peek() and self.peek() not in "():,;[":
+            out.append(self.take())
+        label = "".join(out).strip()
+        return label or None
+
+    def parse_number(self) -> float:
+        out = []
+        while self.peek() and (self.peek().isdigit() or self.peek() in ".+-eE"):
+            out.append(self.take())
+        return float("".join(out))
+
+
+def parse_newick(text: str) -> NewickNode:
+    return _Parser(text).parse()
+
+
+def format_newick(root: NewickNode, with_lengths: bool = True,
+                  fmt: str = "%.6f") -> str:
+    def rec(node: NewickNode) -> str:
+        if node.is_leaf:
+            s = node.name or ""
+        else:
+            s = "(" + ",".join(rec(c) for c in node.children) + ")"
+            if node.name:
+                s += node.name
+        if with_lengths and node.length is not None:
+            s += ":" + (fmt % node.length)
+        return s
+    return rec(root) + ";"

@@ -1,0 +1,112 @@
+"""RAxML/ExaML partition ("model") file parser.
+
+Grammar (reference: `parser/parsePartitions.c:383`, `parser/USAGE`):
+    <MODEL>, <name> = <range>[, <range>...]
+    range := a | a-b | a-b\\s          (1-based, inclusive, optional stride s)
+    MODEL := DNA | BIN | <protein matrix name> | AUTO | GTR | LG4M | LG4X
+             with optional suffix F (empirical frequencies) or X (ML-optimized
+             frequencies); DNA defaults to empirical, DNAX optimizes.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from typing import List
+
+import numpy as np
+
+from examl_tpu import datatypes
+
+PROT_MODELS = [
+    "DAYHOFF", "DCMUT", "JTT", "MTREV", "WAG", "RTREV", "CPREV", "VT",
+    "BLOSUM62", "MTMAM", "LG", "MTART", "MTZOA", "PMB", "HIVB", "HIVW",
+    "JTTDCMUT", "FLU", "STMTREV", "AUTO", "LG4M", "LG4X", "GTR",
+]
+
+
+@dataclass
+class PartitionSpec:
+    name: str
+    datatype_name: str          # "DNA" | "AA" | "BIN"
+    model_name: str             # "GTR" for DNA/BIN; matrix name for AA
+    sites: np.ndarray           # 0-based global site indices
+    empirical_freqs: bool = False
+    optimize_freqs: bool = False
+    lg4: bool = False
+    auto: bool = False
+    branch_index: int = 0       # per-partition branch-length slot (-M)
+    extra: dict = field(default_factory=dict)
+
+
+def _parse_ranges(text: str, nsites_hint: int | None = None) -> np.ndarray:
+    sites: List[int] = []
+    for piece in text.split(","):
+        piece = piece.strip()
+        if not piece:
+            continue
+        m = re.fullmatch(r"(\d+)(?:\s*-\s*(\d+))?(?:\s*\\\s*(\d+))?", piece)
+        if not m:
+            raise ValueError(f"bad partition range {piece!r}")
+        a = int(m.group(1))
+        b = int(m.group(2)) if m.group(2) else a
+        stride = int(m.group(3)) if m.group(3) else 1
+        sites.extend(range(a - 1, b, stride))
+    return np.asarray(sorted(set(sites)), dtype=np.int64)
+
+
+def _parse_model_token(tok: str) -> PartitionSpec:
+    t = tok.strip().upper()
+    if t in ("BIN", "BINX", "BINARY"):
+        return PartitionSpec("", "BIN", "GTR", np.empty(0, np.int64),
+                             empirical_freqs=True, optimize_freqs=t.endswith("X"))
+    if t in ("DNA", "DNAF", "DNAX"):
+        return PartitionSpec("", "DNA", "GTR", np.empty(0, np.int64),
+                             empirical_freqs=True, optimize_freqs=t == "DNAX")
+    # Protein models (note: bare "GTR" is the optimizable amino-acid GTR,
+    # as in the reference's model-name table).
+    base, emp, opt = t, False, False
+    if t not in PROT_MODELS:
+        if t.endswith("F") and t[:-1] in PROT_MODELS:
+            base, emp = t[:-1], True
+        elif t.endswith("X") and t[:-1] in PROT_MODELS:
+            base, opt = t[:-1], True
+        else:
+            raise ValueError(f"unknown model {tok!r}")
+    return PartitionSpec("", "AA", base, np.empty(0, np.int64),
+                         empirical_freqs=emp or base == "GTR",
+                         optimize_freqs=opt,
+                         lg4=base in ("LG4M", "LG4X"), auto=base == "AUTO")
+
+
+def parse_partition_file(path: str) -> List[PartitionSpec]:
+    specs: List[PartitionSpec] = []
+    with open(path) as f:
+        for raw in f:
+            line = raw.strip()
+            if not line or line.startswith("#"):
+                continue
+            head, _, ranges = line.partition("=")
+            if not ranges:
+                raise ValueError(f"bad partition line {line!r}")
+            model_tok, _, name = head.partition(",")
+            if not name.strip():
+                raise ValueError(f"bad partition line {line!r}")
+            spec = _parse_model_token(model_tok)
+            spec.name = name.strip()
+            spec.sites = _parse_ranges(ranges)
+            specs.append(spec)
+    seen = np.concatenate([s.sites for s in specs]) if specs else np.empty(0)
+    if len(seen) != len(set(seen.tolist())):
+        raise ValueError(f"{path}: overlapping partition ranges")
+    return specs
+
+
+def single_partition_spec(datatype_name: str, nsites: int,
+                          model_name: str = "GTR") -> PartitionSpec:
+    dt = datatypes.get(datatype_name)
+    spec = PartitionSpec("NoName", dt.name, model_name,
+                         np.arange(nsites, dtype=np.int64))
+    if dt.name != "AA":
+        spec.empirical_freqs = True
+    return spec

@@ -1,0 +1,89 @@
+"""Relaxed-PHYLIP alignment reader (sequential and interleaved).
+
+Equivalent role to the reference parser's `getinput` (ExaML
+`parser/axml.c:1027`): header "<ntaxa> <nsites>", then taxon rows.  Supports
+both layouts:
+  - sequential: each taxon's name followed by its sequence, possibly wrapped
+    over several lines (greedy: continuation lines are consumed until the
+    taxon has nsites characters);
+  - interleaved: a first block of name+chunk rows, then bare chunk blocks
+    appended round-robin.
+The sequential parse is attempted first; on inconsistency the interleaved
+interpretation is used.
+"""
+
+from __future__ import annotations
+
+from typing import List, Tuple
+
+
+def _clean(line: str) -> str:
+    return line.replace(" ", "").replace("\t", "")
+
+
+def _parse_sequential(lines: List[str], ntaxa: int,
+                      nsites: int) -> Tuple[List[str], List[str]]:
+    names: List[str] = []
+    seqs: List[str] = []
+    idx = 0
+    for _ in range(ntaxa):
+        if idx >= len(lines):
+            raise ValueError("unexpected end of file")
+        parts = lines[idx].split(None, 1)
+        idx += 1
+        name = parts[0]
+        chars = _clean(parts[1]) if len(parts) > 1 else ""
+        while len(chars) < nsites:
+            if idx >= len(lines):
+                raise ValueError(f"taxon {name}: sequence too short")
+            chars += _clean(lines[idx])
+            idx += 1
+        if len(chars) != nsites:
+            raise ValueError(f"taxon {name}: sequence length mismatch")
+        names.append(name)
+        seqs.append(chars)
+    if idx != len(lines):
+        raise ValueError("trailing content after last taxon")
+    return names, seqs
+
+
+def _parse_interleaved(lines: List[str], ntaxa: int,
+                       nsites: int) -> Tuple[List[str], List[str]]:
+    if len(lines) < ntaxa or len(lines) % ntaxa != 0:
+        raise ValueError(f"interleaved PHYLIP needs a multiple of {ntaxa} rows")
+    names: List[str] = []
+    seqs: List[str] = [""] * ntaxa
+    for i, line in enumerate(lines):
+        row = i % ntaxa
+        if i < ntaxa:
+            parts = line.split(None, 1)
+            names.append(parts[0])
+            seqs[row] += _clean(parts[1]) if len(parts) > 1 else ""
+        else:
+            seqs[row] += _clean(line)
+    for name, s in zip(names, seqs):
+        if len(s) != nsites:
+            raise ValueError(
+                f"taxon {name} has {len(s)} sites, expected {nsites}")
+    return names, seqs
+
+
+def read_phylip(path: str) -> Tuple[List[str], List[str]]:
+    """Returns (taxon_names, sequences) as raw character strings."""
+    with open(path) as f:
+        header = f.readline().split()
+        if len(header) < 2:
+            raise ValueError(f"{path}: bad PHYLIP header")
+        ntaxa, nsites = int(header[0]), int(header[1])
+        lines = [ln.strip() for ln in f if ln.strip()]
+
+    try:
+        names, seqs = _parse_sequential(lines, ntaxa, nsites)
+    except ValueError:
+        try:
+            names, seqs = _parse_interleaved(lines, ntaxa, nsites)
+        except ValueError as e:
+            raise ValueError(f"{path}: cannot parse as PHYLIP: {e}")
+    if len(names) != ntaxa:
+        raise ValueError(f"{path}: expected {ntaxa} taxa, found {len(names)}")
+    return names, seqs

@@ -1,0 +1,2 @@
+from examl_tpu.models.gtr import ModelParams, build_model, eigen_gtr  # noqa: F401
+from examl_tpu.models.gamma import gamma_category_rates  # noqa: F401

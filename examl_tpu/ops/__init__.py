@@ -1,0 +1,1 @@
+from examl_tpu.ops.engine import LikelihoodEngine, DeviceModels  # noqa: F401

@@ -1,0 +1,210 @@
+"""LikelihoodEngine: device-resident CLV state + jitted kernel dispatch.
+
+One engine instance manages one state-count bucket (see parallel/packing.py):
+the CLV tensor `[rows, blocks, lane, rates, states]`, the per-(row, site)
+scaling exponents, and jit-compiled traversal / root-evaluation / derivative
+programs.  Traversal programs are compiled per power-of-two entry count so
+partial traversals (typically 3-4 entries, reference
+`newviewGenericSpecial.c:925`) reuse a handful of compiled variants.
+
+CLV rows are indexed by tree-node number - 1 (tips 1..n hold their constant
+tip indicator vectors, inner nodes n+1..2n-2 are recomputed on traversal);
+the last row is scratch for padding entries.  This mirrors the reference's
+one-CLV-per-inner-node memory scheme (`axml.h:533-629` xVector).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from examl_tpu.models.gtr import ModelParams
+from examl_tpu.ops import kernels
+from examl_tpu.ops.kernels import DeviceModels, Traversal
+from examl_tpu.parallel.packing import PackedBucket
+from examl_tpu.tree.topology import TraversalEntry
+
+
+def stack_models(models: Sequence[ModelParams],
+                 branch_indices: Sequence[int], dtype) -> DeviceModels:
+    R = models[0].ncat
+    assert all(m.ncat == R for m in models)
+    arr = lambda xs: jnp.asarray(np.stack(xs), dtype=dtype)
+    return DeviceModels(
+        eign=arr([m.eign for m in models]),
+        ev=arr([m.ev for m in models]),
+        ei=arr([m.ei for m in models]),
+        freqs=arr([m.freqs for m in models]),
+        gamma_rates=arr([m.gamma_rates for m in models]),
+        rate_weights=arr([np.full(R, 1.0 / R) for m in models]),
+        part_branch=jnp.asarray(np.asarray(branch_indices, dtype=np.int32)),
+    )
+
+
+def _next_pow2(n: int) -> int:
+    p = 1
+    while p < n:
+        p *= 2
+    return p
+
+
+class LikelihoodEngine:
+    def __init__(self, bucket: PackedBucket, models: Sequence[ModelParams],
+                 ntips: int, num_branch_slots: int = 1,
+                 branch_indices: Optional[Sequence[int]] = None,
+                 dtype=jnp.float64, sharding=None,
+                 scale_exp: Optional[int] = None):
+        self.bucket = bucket
+        self.ntips = ntips
+        self.dtype = jnp.dtype(dtype)
+        self.scale_exp = (scale_exp if scale_exp is not None
+                          else kernels.default_scale_exponent(self.dtype))
+        self.num_branch_slots = num_branch_slots
+        self.num_parts = bucket.num_parts
+        self.num_rows = 2 * ntips - 1          # node rows + 1 scratch
+        self.scratch_row = self.num_rows - 1
+        self.sharding = sharding
+
+        lane = bucket.lane
+        B = bucket.num_blocks
+        self.B, self.lane = B, lane
+        self.R = models[0].ncat
+        self.K = bucket.states
+
+        if branch_indices is None:
+            branch_indices = [0] * self.num_parts
+        self._branch_indices = list(branch_indices)
+        self.models = stack_models(models, branch_indices, self.dtype)
+
+        self.block_part = jnp.asarray(bucket.block_part)
+        self.weights = jnp.asarray(
+            bucket.weights.reshape(B, lane), dtype=self.dtype)
+
+        # Tip CLVs: indicator vectors per code, broadcast across rates.
+        tip = self._build_tip_clvs()
+        clv = jnp.zeros((self.num_rows, B, lane, self.R, self.K),
+                        dtype=self.dtype)
+        clv = clv.at[:ntips].set(tip)
+        self.clv = clv
+        self.scaler = jnp.zeros((self.num_rows, B, lane), dtype=jnp.int32)
+        if sharding is not None:
+            self.apply_sharding(sharding)
+
+        # One jitted traversal program; jax recompiles per padded entry-count
+        # shape (powers of two, so only a handful of variants exist).
+        self._jit_traverse = jax.jit(
+            lambda clv, scaler, tv, dm, block_part: kernels.traverse(
+                dm, block_part, clv, scaler, tv, self.scale_exp))
+        self._jit_evaluate = jax.jit(self._evaluate_impl)
+        self._jit_sumtable = jax.jit(self._sumtable_impl)
+        self._jit_derivs = jax.jit(self._derivs_impl)
+
+    # -- construction helpers ---------------------------------------------
+
+    def _build_tip_clvs(self) -> jax.Array:
+        from examl_tpu import datatypes
+        if self.K == 4:
+            dt = datatypes.DNA
+        elif self.K == 20:
+            dt = datatypes.AA
+        else:
+            dt = datatypes.BINARY
+        table = jnp.asarray(dt.tip_indicator_table(), dtype=self.dtype)
+        codes = jnp.asarray(self.bucket.tip_codes.astype(np.int32))
+        tip = table[codes]                                   # [ntaxa, S, K]
+        tip = tip.reshape(self.ntips, self.B, self.lane, 1, self.K)
+        return jnp.broadcast_to(
+            tip, (self.ntips, self.B, self.lane, self.R, self.K))
+
+    def apply_sharding(self, sharding) -> None:
+        """Shard the block axis of the big per-site tensors."""
+        self.sharding = sharding
+        self.clv = jax.device_put(self.clv, sharding.clv)
+        self.scaler = jax.device_put(self.scaler, sharding.scaler)
+        self.weights = jax.device_put(self.weights, sharding.sites)
+        self.block_part = jax.device_put(self.block_part, sharding.blocks)
+
+    def set_models(self, models: Sequence[ModelParams]) -> None:
+        self.models = stack_models(models, self._branch_indices, self.dtype)
+
+    def invalidate_tips_changed(self) -> None:
+        self.clv = self.clv.at[:self.ntips].set(self._build_tip_clvs())
+
+    # -- traversal ---------------------------------------------------------
+
+    def _traversal_arrays(self, entries: List[TraversalEntry]) -> Traversal:
+        E = _next_pow2(max(len(entries), 1))
+        C = self.num_branch_slots
+        parent = np.full(E, self.scratch_row, dtype=np.int32)
+        left = np.zeros(E, dtype=np.int32)
+        right = np.zeros(E, dtype=np.int32)
+        zl = np.ones((E, C), dtype=np.float64)
+        zr = np.ones((E, C), dtype=np.float64)
+        for i, e in enumerate(entries):
+            parent[i] = e.parent - 1
+            left[i] = e.left - 1
+            right[i] = e.right - 1
+            zl[i, :] = _z_slots(e.zl, C)
+            zr[i, :] = _z_slots(e.zr, C)
+        return Traversal(parent=jnp.asarray(parent), left=jnp.asarray(left),
+                         right=jnp.asarray(right),
+                         zl=jnp.asarray(zl, dtype=self.dtype),
+                         zr=jnp.asarray(zr, dtype=self.dtype))
+
+    def run_traversal(self, entries: List[TraversalEntry]) -> None:
+        if not entries:
+            return
+        tv = self._traversal_arrays(entries)
+        self.clv, self.scaler = self._jit_traverse(
+            self.clv, self.scaler, tv, self.models, self.block_part)
+
+    # -- evaluation --------------------------------------------------------
+
+    def _evaluate_impl(self, clv, scaler, p_row, q_row, z, dm, block_part,
+                       weights):
+        return kernels.root_log_likelihood(
+            dm, block_part, weights, clv, scaler,
+            p_row, q_row, z, self.num_parts, self.scale_exp)
+
+    def evaluate(self, p_num: int, q_num: int, z: Sequence[float]) -> np.ndarray:
+        """Per-partition lnL [M] at branch (p,q); CLVs must be current."""
+        zv = jnp.asarray(_z_slots(z, self.num_branch_slots), dtype=self.dtype)
+        out = self._jit_evaluate(self.clv, self.scaler,
+                                 jnp.int32(p_num - 1), jnp.int32(q_num - 1),
+                                 zv, self.models, self.block_part,
+                                 self.weights)
+        return np.asarray(out)
+
+    # -- branch derivatives ------------------------------------------------
+
+    def _sumtable_impl(self, clv, p_row, q_row, dm, block_part):
+        return kernels.sumtable(dm, block_part, clv[p_row], clv[q_row])
+
+    def _derivs_impl(self, st, z, dm, block_part, weights):
+        return kernels.nr_derivatives(dm, block_part, weights,
+                                      st, z, self.num_branch_slots)
+
+    def make_sumtable(self, p_num: int, q_num: int) -> jax.Array:
+        return self._jit_sumtable(self.clv, jnp.int32(p_num - 1),
+                                  jnp.int32(q_num - 1), self.models,
+                                  self.block_part)
+
+    def branch_derivatives(self, st: jax.Array, z: Sequence[float]):
+        zv = jnp.asarray(_z_slots(z, self.num_branch_slots), dtype=self.dtype)
+        d1, d2 = self._jit_derivs(st, zv, self.models, self.block_part,
+                                  self.weights)
+        return np.asarray(d1), np.asarray(d2)
+
+
+def _z_slots(z: Sequence[float] | float, num_slots: int) -> np.ndarray:
+    z = np.atleast_1d(np.asarray(z, dtype=np.float64))
+    if len(z) == num_slots:
+        return z
+    if len(z) == 1:
+        return np.full(num_slots, z[0])
+    if len(z) > num_slots:
+        return z[:num_slots]
+    raise ValueError(f"branch vector length {len(z)} vs slots {num_slots}")

@@ -1,0 +1,223 @@
+"""Device kernels of the likelihood engine (jnp; Pallas variants can slot in).
+
+TPU-native re-design of the reference's hand-vectorized kernel inventory
+(ExaML `newviewGenericSpecial.c`, `evaluateGenericSpecial.c`,
+`makenewzGenericSpecial.c`, SSE3/AVX/MIC backends): ONE shape-polymorphic
+kernel set over a packed site axis, with the state count (2/4/20), rate
+count and partition count as static dimensions.  All functions are pure and
+jit/vmap/shard-safe; the site axis is laid out as [B blocks x lane] so
+per-partition P matrices are gathered per block (see parallel/packing.py).
+
+Index conventions (einsum letters):
+  b block, l lane, r rate category, j eigen index, a/k state, m partition,
+  n CLV row, e traversal entry, c branch slot (per-partition branch lengths).
+
+CLV scaling follows the reference scheme (`newviewGenericSpecial.c:604-616`):
+when every entry of a site's CLV drops below 2^-E the site is multiplied by
+2^E and an integer per-(node, site) scaler increments; lnL adds
+scaler * log(2^-E).  E is 256 for float64 (as the reference) and 64 for
+float32.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class DeviceModels(NamedTuple):
+    """Stacked per-partition model tensors for one state-count bucket."""
+    eign: jax.Array         # [M, K]  negated eigenvalues, [:,0] == 0
+    ev: jax.Array           # [M, K, K] right eigenvectors (columns of P decomp)
+    ei: jax.Array           # [M, K, K] left eigenvectors (rows)
+    freqs: jax.Array        # [M, K]
+    gamma_rates: jax.Array  # [M, R]
+    rate_weights: jax.Array  # [M, R] category weights (1/R for GAMMA)
+    part_branch: jax.Array  # [M] int32: branch slot per partition (0 if linked)
+
+
+class Traversal(NamedTuple):
+    """Fixed-size padded traversal descriptor (host-built)."""
+    parent: jax.Array       # [E] int32 CLV row
+    left: jax.Array         # [E] int32
+    right: jax.Array        # [E] int32
+    zl: jax.Array           # [E, C] branch z to left child
+    zr: jax.Array           # [E, C]
+
+
+def default_scale_exponent(dtype, backend: str | None = None) -> int:
+    """Rescale threshold exponent E (threshold 2^-E, multiplier 2^E).
+
+    float64 on CPU uses the reference's 256.  On TPU float64 is emulated as
+    float-float pairs whose exponent range is float32's (underflow near
+    2^-126), and float32 anywhere has the same floor — both need rescaling
+    long before products of two CLVs approach 2^-126, so use 32.
+    """
+    if backend is None:
+        import jax
+        backend = jax.default_backend()
+    if jnp.dtype(dtype) == jnp.float64 and backend == "cpu":
+        return 256
+    return 32
+
+
+def scale_constants(dtype, scale_exp: int):
+    e = scale_exp
+    two_e = jnp.asarray(2.0, dtype) ** e
+    minlik = jnp.asarray(2.0, dtype) ** (-e)
+    log_min = -e * jnp.log(jnp.asarray(2.0, dtype))
+    return minlik, two_e, log_min
+
+
+def branch_decay(models: DeviceModels, z: jax.Array) -> jax.Array:
+    """d[m, r, j] = exp(eign_j * rate_r * log z_m), the eigenvalue decay terms.
+
+    z: [C] per-branch-slot values; each partition selects its slot.
+    Mirrors reference `makeP` (`newviewGenericSpecial.c:78-168`).
+    """
+    zm = z[models.part_branch]                              # [M]
+    lz = jnp.log(zm)
+    return jnp.exp(models.eign[:, None, :]
+                   * models.gamma_rates[:, :, None]
+                   * lz[:, None, None])                     # [M, R, K]
+
+
+def p_matrices(models: DeviceModels, z: jax.Array) -> jax.Array:
+    """P[m, r, a, k] = sum_j ev[a,j] d[j] ei[j,k] — dense per-partition P."""
+    d = branch_decay(models, z)
+    return jnp.einsum("maj,mrj,mjk->mrak", models.ev, d, models.ei)
+
+
+def apply_p(pmat: jax.Array, block_part: jax.Array, x: jax.Array) -> jax.Array:
+    """y[b,l,r,a] = sum_k P[part(b),r,a,k] * x[b,l,r,k]."""
+    pb = pmat[block_part]                                   # [B, R, K, K]
+    return jnp.einsum("brak,blrk->blra", pb, x)
+
+
+def newview_block(models: DeviceModels, block_part: jax.Array,
+                  xl: jax.Array, xr: jax.Array,
+                  zl: jax.Array, zr: jax.Array, scale_exp: int):
+    """Combine two child CLVs into the parent CLV (one traversal entry).
+
+    xl, xr: [B, lane, R, K].  Returns (clv [B,lane,R,K], scale_inc [B,lane]).
+    Reference semantics: `newviewGAMMA_FLEX` (`newviewGenericSpecial.c:430-682`).
+    """
+    yl = apply_p(p_matrices(models, zl), block_part, xl)
+    yr = apply_p(p_matrices(models, zr), block_part, xr)
+    v = yl * yr
+    minlik, two_e, _ = scale_constants(v.dtype, scale_exp)
+    vmax = jnp.max(jnp.abs(v), axis=(2, 3))                 # [B, lane]
+    needs = vmax < minlik
+    v = jnp.where(needs[:, :, None, None], v * two_e, v)
+    return v, needs.astype(jnp.int32)
+
+
+def traverse(models: DeviceModels, block_part: jax.Array,
+             clv: jax.Array, scaler: jax.Array, tv: Traversal,
+             scale_exp: int):
+    """Execute a padded traversal descriptor as a lax.scan over entries.
+
+    clv: [N, B, lane, R, K]; scaler: [N, B, lane] int32.
+    Padding entries must write to a scratch row (host sets parent=N-1).
+    Reference: `newviewIterative` (`newviewGenericSpecial.c:917-1515`).
+    """
+    def body(carry, e):
+        clv, scaler = carry
+        parent, left, right, zl, zr = e
+        v, inc = newview_block(models, block_part, clv[left], clv[right],
+                               zl, zr, scale_exp)
+        sc = scaler[left] + scaler[right] + inc
+        clv = clv.at[parent].set(v)
+        scaler = scaler.at[parent].set(sc)
+        return (clv, scaler), None
+
+    (clv, scaler), _ = jax.lax.scan(
+        body, (clv, scaler),
+        (tv.parent, tv.left, tv.right, tv.zl, tv.zr))
+    return clv, scaler
+
+
+def site_likelihoods(models: DeviceModels, block_part: jax.Array,
+                     xp: jax.Array, xq: jax.Array, z: jax.Array):
+    """Per-site likelihood L[b,l] at the root branch (p,q) with branch z.
+
+    L = sum_r w_r sum_k f_k * xp_k * (P(z) xq)_k
+    Reference: `evaluateGAMMA_FLEX` (`evaluateGenericSpecial.c:154-231`).
+    """
+    y = apply_p(p_matrices(models, z), block_part, xq)      # [B,l,R,K]
+    fb = models.freqs[block_part]                           # [B, K]
+    wb = models.rate_weights[block_part]                    # [B, R]
+    return jnp.einsum("bk,br,blrk,blrk->bl", fb, wb, xp, y)
+
+
+def root_log_likelihood(models: DeviceModels, block_part: jax.Array,
+                        weights: jax.Array, clv: jax.Array, scaler: jax.Array,
+                        p_row, q_row, z: jax.Array, num_parts: int,
+                        scale_exp: int):
+    """Per-partition log likelihoods [M] after a traversal.
+
+    weights: [B, lane] pattern weights (0 on padding).
+    Reference: `evaluateGeneric` + the lnL Allreduce
+    (`evaluateGenericSpecial.c:897-1001`); here the cross-device sum is the
+    segment/jnp sum over the sharded block axis (XLA inserts the collective).
+    """
+    lsite = site_likelihoods(models, block_part, clv[p_row], clv[q_row], z)
+    _, _, log_min = scale_constants(lsite.dtype, scale_exp)
+    sc = (scaler[p_row] + scaler[q_row]).astype(lsite.dtype)
+    lsite = jnp.maximum(lsite, jnp.finfo(lsite.dtype).tiny)
+    site_lnl = weights * (jnp.log(lsite) + sc * log_min)    # [B, lane]
+    block_lnl = jnp.sum(site_lnl, axis=1)                   # [B]
+    return jax.ops.segment_sum(block_lnl, block_part, num_segments=num_parts)
+
+
+def sumtable(models: DeviceModels, block_part: jax.Array,
+             xp: jax.Array, xq: jax.Array) -> jax.Array:
+    """st[b,l,r,j] = (sum_k f_k xp_k ev[k,j]) * (sum_k ei[j,k] xq_k).
+
+    With this table L(lz) = sum_j st_j exp(eign_j r lz) per site, so branch
+    derivatives w.r.t. lz = log z are cheap per NR iteration.
+    Reference: `makenewzIterative` sum kernels
+    (`makenewzGenericSpecial.c:251-326`).
+    """
+    evb = models.ev[block_part]                             # [B, K, K]
+    eib = models.ei[block_part]
+    fb = models.freqs[block_part]
+    ap = jnp.einsum("bk,blrk,bkj->blrj", fb, xp, evb)
+    bq = jnp.einsum("bjk,blrk->blrj", eib, xq)
+    return ap * bq
+
+
+def nr_derivatives(models: DeviceModels, block_part: jax.Array,
+                   weights: jax.Array, st: jax.Array, z: jax.Array,
+                   num_slots: int):
+    """(lnL', lnL'') w.r.t. lz summed over sites, per branch slot [C].
+
+    Reference: `coreGAMMA_FLEX` + derivative Allreduce
+    (`makenewzGenericSpecial.c:523-619, 1241-1248`).
+    """
+    d = branch_decay(models, z)                             # [M, R, K]
+    e1 = models.eign[:, None, :] * models.gamma_rates[:, :, None]
+    wb = models.rate_weights[block_part]                    # [B, R]
+    db = d[block_part]                                      # [B, R, K]
+    e1b = e1[block_part]
+
+    lsite = jnp.einsum("br,blrj,brj->bl", wb, st, db)
+    dsite = jnp.einsum("br,blrj,brj,brj->bl", wb, st, db, e1b)
+    d2site = jnp.einsum("br,blrj,brj,brj,brj->bl", wb, st, db, e1b, e1b)
+
+    lsite = jnp.maximum(lsite, jnp.finfo(lsite.dtype).tiny)
+    dlnl = dsite / lsite
+    d2lnl = d2site / lsite - dlnl * dlnl
+    blk_d1 = jnp.sum(weights * dlnl, axis=1)
+    blk_d2 = jnp.sum(weights * d2lnl, axis=1)
+    per_part_d1 = jax.ops.segment_sum(blk_d1, block_part,
+                                      num_segments=models.eign.shape[0])
+    per_part_d2 = jax.ops.segment_sum(blk_d2, block_part,
+                                      num_segments=models.eign.shape[0])
+    d1 = jax.ops.segment_sum(per_part_d1, models.part_branch,
+                             num_segments=num_slots)
+    d2 = jax.ops.segment_sum(per_part_d2, models.part_branch,
+                             num_segments=num_slots)
+    return d1, d2

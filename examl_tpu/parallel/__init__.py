@@ -1,0 +1,1 @@
+from examl_tpu.parallel.packing import PackedBucket, pack_partitions  # noqa: F401

@@ -1,0 +1,108 @@
+"""Site packing: partitions -> lane-aligned blocks for the TPU site axis.
+
+This is the TPU-native replacement for the reference's load balancer
+("Kassian's algorithm", ExaML `partitionAssignment.c:156-450`): instead of
+assigning (partition, offset, width) chunks to MPI ranks, each partition's
+pattern columns are padded with zero-weight sites to a multiple of the lane
+width (the MIC backend's zero-weight `VECTOR_PADDING` trick, ExaML
+`axml.c:2060-2073`, generalized), concatenated into one flat site axis, and
+the resulting 128-site blocks are sharded uniformly over the device mesh.
+Because every block belongs to exactly one partition, per-block P-matrix
+gathers stay cheap and per-partition reductions are segment sums.
+
+Partitions of different state counts (DNA=4 vs AA=20) go into separate
+buckets, each compiled as its own device program — the same per-data-type
+split the reference balancer performs (`partitionAssignment.c:398-450`).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Sequence, Tuple
+
+import numpy as np
+
+from examl_tpu.constants import TPU_LANE
+from examl_tpu.io.alignment import PartitionData
+
+
+@dataclass
+class PackedBucket:
+    """All partitions of one state count packed into a flat padded site axis."""
+    states: int
+    lane: int
+    tip_codes: np.ndarray       # [ntaxa, S] uint8 (padding = undetermined code)
+    weights: np.ndarray         # [S] float64, 0.0 on padding sites
+    site_part: np.ndarray       # [S] int32 local partition id
+    block_part: np.ndarray      # [B] int32 local partition id per block
+    part_ids: List[int]         # local id -> global partition index
+    part_offsets: np.ndarray    # [M] start of each partition's padded range
+    part_widths: np.ndarray     # [M] true (unpadded) pattern counts
+
+    @property
+    def num_sites(self) -> int:
+        return self.tip_codes.shape[1]
+
+    @property
+    def num_blocks(self) -> int:
+        return self.num_sites // self.lane
+
+    @property
+    def num_parts(self) -> int:
+        return len(self.part_ids)
+
+    def site_indices(self, local_part: int) -> np.ndarray:
+        """Padded-axis indices of partition's true patterns."""
+        o = int(self.part_offsets[local_part])
+        w = int(self.part_widths[local_part])
+        return np.arange(o, o + w)
+
+
+def _round_up(x: int, mult: int) -> int:
+    return ((x + mult - 1) // mult) * mult
+
+
+def pack_partitions(partitions: Sequence[PartitionData],
+                    lane: int = TPU_LANE,
+                    block_multiple: int = 1) -> Dict[int, PackedBucket]:
+    """Group partitions by state count and pack each group.
+
+    block_multiple: total block count is rounded up to a multiple of this
+    (set to the mesh's site-axis size so sharding divides evenly).
+    """
+    by_states: Dict[int, List[Tuple[int, PartitionData]]] = {}
+    for gid, part in enumerate(partitions):
+        by_states.setdefault(part.states, []).append((gid, part))
+
+    buckets: Dict[int, PackedBucket] = {}
+    for states, group in sorted(by_states.items()):
+        ntaxa = group[0][1].patterns.shape[0]
+        undet = group[0][1].datatype.undetermined_code
+        padded = [_round_up(max(p.width, 1), lane) for _, p in group]
+        total = _round_up(sum(padded), lane * block_multiple)
+
+        tip_codes = np.full((ntaxa, total), undet, dtype=np.uint8)
+        weights = np.zeros(total, dtype=np.float64)
+        site_part = np.zeros(total, dtype=np.int32)
+        offsets = np.zeros(len(group), dtype=np.int64)
+        widths = np.zeros(len(group), dtype=np.int64)
+
+        off = 0
+        for li, ((gid, part), pw) in enumerate(zip(group, padded)):
+            w = part.width
+            tip_codes[:, off:off + w] = part.patterns
+            weights[off:off + w] = part.weights
+            site_part[off:off + pw] = li
+            offsets[li] = off
+            widths[li] = w
+            off += pw
+        # Trailing alignment blocks keep partition id of the last partition.
+        site_part[off:] = len(group) - 1
+
+        block_part = site_part.reshape(-1, lane)[:, 0].copy()
+        buckets[states] = PackedBucket(
+            states=states, lane=lane, tip_codes=tip_codes, weights=weights,
+            site_part=site_part, block_part=block_part,
+            part_ids=[gid for gid, _ in group],
+            part_offsets=offsets, part_widths=widths)
+    return buckets

@@ -1,0 +1,1 @@
+from examl_tpu.tree.topology import Node, Tree, TraversalEntry  # noqa: F401

@@ -1,0 +1,294 @@
+"""Unrooted binary tree with node-triple inner nodes and CLV orientation flags.
+
+Host-side topology bookkeeping, the same data model as the reference
+(ExaML `axml.h:492-506` `node`/`nodeptr`, `newviewGenericSpecial.c:691`
+`computeTraversalInfo`): tips are numbered 1..n, inner nodes n+1..2n-2; an
+inner node is a cycle of three slots (`next` pointers); each slot has a
+`back` pointer across a branch; the `x` flag marks which of a cycle's slots
+the node's single CLV is currently oriented towards (the CLV summarizes the
+subtree away from that slot's `back`).
+
+The device engine (ops/engine.py) never sees this structure — only flat
+traversal descriptors produced here.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from examl_tpu.constants import DEFAULTZ, ZMAX, ZMIN
+from examl_tpu.io.newick import NewickNode, format_newick, parse_newick
+
+
+class Node:
+    __slots__ = ("number", "back", "next", "z", "x")
+
+    def __init__(self, number: int):
+        self.number = number
+        self.back: Optional[Node] = None
+        self.next: Optional[Node] = None
+        self.z: List[float] = []
+        self.x: bool = False
+
+    def __repr__(self):
+        b = self.back.number if self.back else None
+        return f"<Node {self.number} back={b} x={self.x}>"
+
+
+def hookup(p: Node, q: Node, z: Sequence[float]) -> None:
+    """Connect two slots with a shared branch-length vector."""
+    p.back = q
+    q.back = p
+    shared = [min(max(v, ZMIN), ZMAX) for v in z]
+    p.z = shared
+    q.z = shared
+
+
+class TraversalEntry:
+    """One inner-node CLV update: parent from (left, right) children."""
+    __slots__ = ("parent", "left", "right", "zl", "zr")
+
+    def __init__(self, parent: int, left: int, right: int,
+                 zl: Sequence[float], zr: Sequence[float]):
+        self.parent = parent
+        self.left = left
+        self.right = right
+        self.zl = tuple(zl)
+        self.zr = tuple(zr)
+
+    def __repr__(self):
+        return f"TE(p={self.parent},l={self.left},r={self.right})"
+
+
+class Tree:
+    """Unrooted strictly-binary tree over tips 1..ntips."""
+
+    def __init__(self, ntips: int, num_branches: int = 1):
+        if ntips < 3:
+            raise ValueError("need at least 3 taxa for an unrooted tree")
+        self.ntips = ntips
+        self.num_branches = num_branches
+        self.nodep: Dict[int, Node] = {}          # canonical slot per number
+        for i in range(1, ntips + 1):
+            self.nodep[i] = Node(i)
+        self._next_inner = ntips + 1
+
+    # -- structure helpers -------------------------------------------------
+
+    @property
+    def max_nodes(self) -> int:
+        return 2 * self.ntips - 2
+
+    def is_tip(self, number: int) -> bool:
+        return number <= self.ntips
+
+    def new_inner(self) -> Node:
+        """Allocate an inner node (cycle of three slots)."""
+        num = self._next_inner
+        if num > self.max_nodes:
+            raise RuntimeError("inner node overflow")
+        self._next_inner += 1
+        a, b, c = Node(num), Node(num), Node(num)
+        a.next, b.next, c.next = b, c, a
+        self.nodep[num] = a
+        return a
+
+    def slots(self, number: int):
+        p = self.nodep[number]
+        if self.is_tip(number):
+            return (p,)
+        return (p, p.next, p.next.next)
+
+    def default_z(self) -> List[float]:
+        return [DEFAULTZ] * self.num_branches
+
+    @property
+    def start(self) -> Node:
+        return self.nodep[1]
+
+    def orient(self, p: Node) -> None:
+        """Set the x flag of p's cycle onto slot p."""
+        if self.is_tip(p.number):
+            return
+        p.x = True
+        p.next.x = False
+        p.next.next.x = False
+
+    # -- construction ------------------------------------------------------
+
+    @classmethod
+    def from_newick(cls, text: str, taxon_names: Sequence[str],
+                    num_branches: int = 1) -> "Tree":
+        root = parse_newick(text)
+        root = _deroot(root)
+        name_to_num = {n: i + 1 for i, n in enumerate(taxon_names)}
+        leaves = list(root.leaves())
+        if len(leaves) != len(taxon_names):
+            raise ValueError(
+                f"tree has {len(leaves)} taxa, alignment has {len(taxon_names)}")
+        tree = cls(len(taxon_names), num_branches)
+
+        def build(nw: NewickNode) -> Node:
+            """Return the slot representing subtree nw, to be hooked upward."""
+            if nw.is_leaf:
+                try:
+                    return tree.nodep[name_to_num[nw.name]]
+                except KeyError:
+                    raise ValueError(f"taxon {nw.name!r} not in alignment")
+            if len(nw.children) != 2:
+                raise ValueError("multifurcating inner node (resolve first)")
+            inner = tree.new_inner()
+            for slot, child in zip((inner.next, inner.next.next), nw.children):
+                sub = build(child)
+                hookup(slot, sub, _z_of(child, num_branches))
+            return inner
+
+        if len(root.children) != 3:
+            raise ValueError("expected unrooted (trifurcating) tree after derooting")
+        center = tree.new_inner()
+        c0, c1, c2 = root.children
+        hookup(center, build(c0), _z_of(c0, num_branches))
+        hookup(center.next, build(c1), _z_of(c1, num_branches))
+        hookup(center.next.next, build(c2), _z_of(c2, num_branches))
+        tree._check_connected()
+        return tree
+
+    @classmethod
+    def random(cls, taxon_names: Sequence[str], seed: int = 0,
+               num_branches: int = 1) -> "Tree":
+        """Stepwise random-addition topology (no likelihood): start from a
+        3-taxon star, insert each remaining tip on a uniformly random branch."""
+        rng = np.random.default_rng(seed)
+        n = len(taxon_names)
+        tree = cls(n, num_branches)
+        order = rng.permutation(n) + 1
+        center = tree.new_inner()
+        hookup(center, tree.nodep[int(order[0])], tree.default_z())
+        hookup(center.next, tree.nodep[int(order[1])], tree.default_z())
+        hookup(center.next.next, tree.nodep[int(order[2])], tree.default_z())
+        for num in order[3:]:
+            branches = tree.all_branches()
+            p, q = branches[rng.integers(len(branches))]
+            inner = tree.new_inner()
+            hookup(inner.next, p, p.z)
+            hookup(inner.next.next, q, tree.default_z())
+            hookup(inner, tree.nodep[int(num)], tree.default_z())
+        tree._check_connected()
+        return tree
+
+    def _check_connected(self) -> None:
+        for num in range(1, self._next_inner):
+            for slot in self.slots(num):
+                assert slot.back is not None, f"dangling slot at node {num}"
+
+    # -- traversal descriptors --------------------------------------------
+
+    def compute_traversal(self, p: Node, full: bool) -> List[TraversalEntry]:
+        """Post-order list of CLV updates so that slot p's CLV is valid.
+
+        Partial traversals stop at inner nodes whose x flag is already
+        oriented correctly (reference `computeTraversalInfo`,
+        `newviewGenericSpecial.c:691-813`); full traversals recompute every
+        inner node below p.
+        """
+        entries: List[TraversalEntry] = []
+
+        def rec(s: Node) -> None:
+            if self.is_tip(s.number):
+                return
+            if not full and s.x:
+                return
+            q = s.next.back
+            r = s.next.next.back
+            rec(q)
+            rec(r)
+            entries.append(TraversalEntry(s.number, q.number, r.number, q.z, r.z))
+            self.orient(s)
+
+        rec(p)
+        return entries
+
+    def full_traversal(self) -> Tuple[Node, List[TraversalEntry]]:
+        """Traversal making both ends of the branch at `start` valid."""
+        p = self.start.back
+        entries = self.compute_traversal(p, full=True)
+        return p, entries
+
+    def invalidate_all(self) -> None:
+        for num in range(self.ntips + 1, self._next_inner):
+            for slot in self.slots(num):
+                slot.x = False
+
+    # -- enumeration -------------------------------------------------------
+
+    def all_branches(self) -> List[Tuple[Node, Node]]:
+        """Each branch once, as (slot, slot.back)."""
+        out: List[Tuple[Node, Node]] = []
+        seen = set()
+        for num in range(1, self._next_inner):
+            for slot in self.slots(num):
+                if slot.back is None:
+                    continue
+                key = id(slot.z)
+                if key in seen:
+                    continue
+                seen.add(key)
+                out.append((slot, slot.back))
+        return out
+
+    def inner_numbers(self) -> List[int]:
+        return list(range(self.ntips + 1, self._next_inner))
+
+    # -- newick export -----------------------------------------------------
+
+    def to_newick(self, taxon_names: Sequence[str], with_lengths: bool = True,
+                  branch_index: int = 0) -> str:
+        def t_of(z: float) -> float:
+            return -np.log(min(max(z, ZMIN), ZMAX))
+
+        def rec(slot: Node) -> NewickNode:
+            nw = NewickNode()
+            if self.is_tip(slot.number):
+                nw.name = taxon_names[slot.number - 1]
+            else:
+                for s in (slot.next, slot.next.next):
+                    child = rec(s.back)
+                    child.length = t_of(s.z[branch_index])
+                    nw.children.append(child)
+            return nw
+
+        # Standard unrooted export: trifurcation at start.back with the
+        # starting tip as one child (reference Tree2String starts at
+        # tr->start->back, `treeIO.c:324`).
+        start = self.start           # tip 1
+        root = NewickNode()
+        inner = rec(start.back)
+        root.children = [NewickNode(name=taxon_names[start.number - 1],
+                                    length=t_of(start.z[branch_index]))]
+        root.children.extend(inner.children)
+        return format_newick(root, with_lengths=with_lengths)
+
+
+def _z_of(nw: NewickNode, num_branches: int) -> List[float]:
+    if nw.length is None:
+        return [DEFAULTZ] * num_branches
+    z = float(np.exp(-max(nw.length, 0.0)))
+    z = min(max(z, ZMIN), ZMAX)
+    return [z] * num_branches
+
+
+def _deroot(root: NewickNode) -> NewickNode:
+    """Collapse a bifurcating root into an unrooted trifurcation."""
+    while len(root.children) == 2:
+        a, b = root.children
+        if a.is_leaf and b.is_leaf:
+            raise ValueError("two-taxon tree is not supported")
+        inner, other = (a, b) if not a.is_leaf else (b, a)
+        ta = a.length or 0.0
+        tb = b.length or 0.0
+        other.length = ta + tb
+        new_root = NewickNode(children=list(inner.children) + [other])
+        root = new_root
+    return root

@@ -1,0 +1,28 @@
+"""Test configuration: CPU backend with 8 virtual devices, float64 on.
+
+Multi-chip sharding is validated on a virtual CPU mesh (the driver separately
+dry-runs the multi-chip path); numerics tests need float64 like the
+reference.
+"""
+
+import os
+
+# Force CPU: the environment may preset JAX_PLATFORMS=axon (a real TPU chip
+# behind a single-process tunnel); numerics tests must run on host CPU.
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_enable_x64", True)
+
+import pytest  # noqa: E402,F401
+
+TESTDATA = "/root/reference/testData"
+
+
+def pytest_configure(config):
+    config.addinivalue_line("markers", "slow: long-running end-to-end tests")

@@ -1,0 +1,61 @@
+"""Independent NumPy/SciPy reference implementation of the likelihood.
+
+Plays the role of the reference's portable `*_FLEX` kernels as a numerics
+oracle (SURVEY §4): a direct recursive Felsenstein pruning over the host
+tree, building transition matrices with `scipy.linalg.expm` (a different
+algorithm than the engine's eigendecomposition), no rescaling, no packing.
+Only suitable for small test alignments.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+from scipy.linalg import expm
+
+from examl_tpu.io.alignment import AlignmentData
+from examl_tpu.models.gtr import ModelParams, rates_to_matrix
+from examl_tpu.tree.topology import Node, Tree
+
+
+def generator(model: ModelParams) -> np.ndarray:
+    R = rates_to_matrix(model.rates, model.states)
+    Q = R * model.freqs[None, :]
+    np.fill_diagonal(Q, 0.0)
+    np.fill_diagonal(Q, -Q.sum(axis=1))
+    fracchange = model.freqs @ R @ model.freqs
+    return Q / fracchange
+
+
+def oracle_lnl(tree: Tree, alignment: AlignmentData,
+               models: list[ModelParams], p: Node | None = None) -> float:
+    """Total lnL at branch (p, p.back) via plain pruning."""
+    if p is None:
+        p = tree.start
+    q = p.back
+    total = 0.0
+    for part, model in zip(alignment.partitions, models):
+        table = part.datatype.tip_indicator_table()
+        Q = generator(model)
+        codes = part.patterns          # [ntaxa, W]
+        W = codes.shape[1]
+
+        def down(slot: Node, rate: float) -> np.ndarray:
+            """[W, states] conditional likelihood of subtree behind slot."""
+            if tree.is_tip(slot.number):
+                return table[codes[slot.number - 1]]
+            out = np.ones((W, model.states))
+            for s in (slot.next, slot.next.next):
+                t = -np.log(s.z[0])
+                P = expm(Q * rate * t)
+                out *= down(s.back, rate) @ P.T
+            return out
+
+        site_l = np.zeros(W)
+        for rate in model.gamma_rates:
+            t = -np.log(p.z[0])
+            P = expm(Q * rate * t)
+            vp = down(p, rate)
+            vq = down(q, rate)
+            site_l += (vp * (vq @ P.T)) @ model.freqs / model.ncat
+        total += float(part.weights @ np.log(site_l))
+    return total

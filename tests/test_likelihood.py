@@ -1,0 +1,113 @@
+"""Likelihood engine parity vs the independent oracle + invariance tests."""
+
+import numpy as np
+import pytest
+
+from examl_tpu.instance import PhyloInstance
+from examl_tpu.io.alignment import build_alignment_data, load_alignment
+from examl_tpu.tree.topology import Tree
+
+from tests.conftest import TESTDATA
+from tests.oracle import oracle_lnl
+
+
+@pytest.fixture(scope="module")
+def data49():
+    return load_alignment(f"{TESTDATA}/49", f"{TESTDATA}/49.model")
+
+
+@pytest.fixture(scope="module")
+def tree49_text():
+    with open(f"{TESTDATA}/49.tree") as f:
+        return f.read()
+
+
+def test_lnl_matches_oracle_partitioned(data49, tree49_text):
+    inst = PhyloInstance(data49)
+    tree = inst.tree_from_newick(tree49_text)
+    lnl = inst.evaluate(tree, full=True)
+    ref = oracle_lnl(tree, data49, inst.models)
+    assert lnl < 0
+    assert abs(lnl - ref) / abs(ref) < 1e-10, (lnl, ref)
+
+
+def test_lnl_alpha_and_rates(data49, tree49_text):
+    from examl_tpu.models.gtr import with_alpha, with_rates
+    inst = PhyloInstance(data49)
+    rng = np.random.default_rng(0)
+    for gid in range(len(inst.models)):
+        m = with_alpha(inst.models[gid], 0.3 + 0.4 * gid)
+        m = with_rates(m, rng.uniform(0.5, 3.0, 6))
+        inst.models[gid] = m
+    inst.push_models()
+    tree = inst.tree_from_newick(tree49_text)
+    lnl = inst.evaluate(tree, full=True)
+    ref = oracle_lnl(tree, data49, inst.models)
+    # eigh- vs expm-based paths accumulate slightly differently.
+    assert abs(lnl - ref) / abs(ref) < 1e-8, (lnl, ref)
+
+
+def test_root_branch_invariance(data49, tree49_text):
+    """lnL must not depend on which branch evaluateGeneric roots at."""
+    inst = PhyloInstance(data49)
+    tree = inst.tree_from_newick(tree49_text)
+    lnl0 = inst.evaluate(tree, full=True)
+    vals = []
+    for slot, _ in tree.all_branches()[:10]:
+        vals.append(inst.evaluate(tree, slot, full=True))
+    assert np.allclose(vals, lnl0, rtol=1e-9), (lnl0, vals)
+
+
+def test_partial_traversal_consistency(data49, tree49_text):
+    """Partial (oriented) traversals give the same lnL as full ones."""
+    inst = PhyloInstance(data49)
+    tree = inst.tree_from_newick(tree49_text)
+    lnl_full = inst.evaluate(tree, full=True)
+    # Re-evaluate at several other branches WITHOUT invalidating: partial
+    # traversals must reorient CLVs correctly.
+    for slot, _ in tree.all_branches()[5:15]:
+        lnl = inst.evaluate(tree, slot, full=False)
+        assert abs(lnl - lnl_full) < 1e-9 * abs(lnl_full), (lnl, lnl_full)
+
+
+def _random_alignment(ntaxa, nsites, seed=0):
+    rng = np.random.default_rng(seed)
+    chars = np.array(list("ACGT"))
+    names = [f"t{i}" for i in range(ntaxa)]
+    seqs = ["".join(rng.choice(chars, nsites)) for _ in range(ntaxa)]
+    return build_alignment_data(names, seqs, datatype_name="DNA")
+
+
+def test_scaling_deep_tree():
+    """A 150-taxon caterpillar forces 2^-256 rescaling; lnL must stay finite
+    and be invariant to the evaluation root (scaler bookkeeping check)."""
+    ad = _random_alignment(150, 40, seed=3)
+    inst = PhyloInstance(ad)
+    tree = inst.random_tree(seed=1)
+    for slot, _ in tree.all_branches():
+        slot.z[0] = 0.05   # long branches -> rapid CLV decay
+    lnl0 = inst.evaluate(tree, full=True)
+    assert np.isfinite(lnl0) and lnl0 < 0
+    total_scale = int(np.asarray(inst.engines[4].scaler).sum())
+    assert total_scale > 0, "expected rescaling to trigger"
+    lnl1 = inst.evaluate(tree, tree.all_branches()[40][0], full=True)
+    assert abs(lnl0 - lnl1) < 1e-7 * abs(lnl0), (lnl0, lnl1)
+
+
+def test_makenewz_improves_lnl(data49, tree49_text):
+    inst = PhyloInstance(data49)
+    tree = inst.tree_from_newick(tree49_text)
+    before = inst.evaluate(tree, full=True)
+    p, q = tree.all_branches()[8]
+    z = inst.makenewz(tree, p, q, p.z, maxiter=64)
+    p.z[:] = z
+    after = inst.evaluate(tree, p, full=False)
+    assert after >= before - 1e-9, (before, after)
+    # NR stationarity: derivative at the optimum ~ 0 (unless clamped).
+    from examl_tpu.constants import ZMAX, ZMIN
+    if ZMIN * 1.01 < z[0] < ZMAX * 0.999:
+        inst.new_view(tree, p)
+        inst.new_view(tree, q)
+        st = inst.engines[4].make_sumtable(p.number, q.number)
+        d1, _ = inst.engines[4].branch_derivatives(st, z)
+        assert abs(d1[0]) < 1e-3 * abs(before), d1

@@ -1,0 +1,127 @@
+"""Generate examl_tpu/models/_protein_data.npz from the reference tree.
+
+The empirical amino-acid replacement matrices (DAYHOFF, WAG, LG, ...) are
+published scientific datasets; this tool reads their numeric values out of
+the reference's `models.c` initProtMat tables and stores them as arrays.
+Run once at build time:  python tools/extract_protein_matrices.py
+"""
+
+from __future__ import annotations
+
+import re
+import sys
+
+import numpy as np
+
+SRC = "/root/reference/examl/models.c"
+OUT = "examl_tpu/models/_protein_data.npz"
+
+CASES = ["DAYHOFF", "DCMUT", "JTT", "MTREV", "WAG", "RTREV", "CPREV", "VT",
+         "BLOSUM62", "MTMAM", "LG", "LG4M", "LG4X", "STMTREV", "MTART",
+         "MTZOA", "PMB", "HIVB", "HIVW", "JTTDCMUT", "FLU"]
+
+AA_SCALE = 10.0
+
+
+def case_blocks(text: str):
+    """Split initProtMat's switch body into per-case source chunks."""
+    pat = re.compile(r"case\s+(\w+)\s*:")
+    hits = [(m.group(1), m.start()) for m in pat.finditer(text)]
+    blocks = {}
+    for (name, start), (_, end) in zip(hits, hits[1:] + [("END", len(text))]):
+        if name in CASES:
+            blocks[name] = text[start:end]
+    return blocks
+
+
+def parse_daa_f(block: str):
+    daa = np.zeros(400)
+    f = np.zeros(20)
+    for m in re.finditer(
+            r"daa\[\s*(\d+)\s*\*\s*20\s*\+\s*(\d+)\s*\]\s*=\s*([-\d.eE+]+)",
+            block):
+        i, j, v = int(m.group(1)), int(m.group(2)), float(m.group(3))
+        daa[i * 20 + j] = v
+    for m in re.finditer(r"f\[\s*(\d+)\s*\]\s*=\s*([-\d.eE+]+)", block):
+        f[int(m.group(1))] = float(m.group(2))
+    return daa, f
+
+
+def parse_lg4(block: str):
+    """LG4M/LG4X: `double rates[4][190] = {{...}};` + freqs[4][20]."""
+    def grab(name, rows, cols):
+        m = re.search(name + r"\s*\[4\]\s*\[\d+\]\s*=\s*\{(.*?)\};", block,
+                      re.S)
+        assert m, f"missing {name} initializer"
+        nums = [float(x) for x in re.findall(r"[-\d.eE+]+(?:[eE][-+]?\d+)?",
+                                             m.group(1))]
+        arr = np.asarray(nums)
+        assert arr.size == rows * cols, (name, arr.size)
+        return arr.reshape(rows, cols)
+    return grab(r"rates", 4, 190), grab(r"freqs", 4, 20)
+
+
+def parse_flat_lower(block: str):
+    """STMTREV style: `double rates[190] = {...}` lower-triangle row-major
+    + `double freqs[20] = {...}` (fed through makeAASubstMat)."""
+    def grab(name, count):
+        m = re.search(name + r"\[\d+\]\s*=\s*\{(.*?)\}", block, re.S)
+        assert m, f"missing {name}"
+        nums = [float(x) for x in re.findall(r"[-+]?\d*\.?\d+(?:[eE][-+]?\d+)?",
+                                             m.group(1))]
+        assert len(nums) == count, (name, len(nums))
+        return np.asarray(nums)
+    flat = grab(r"rates", 190)
+    freqs = grab(r"freqs", 20)
+    daa = np.zeros(400)
+    r = 0
+    for i in range(1, 20):
+        for j in range(i):
+            daa[i * 20 + j] = flat[r]
+            r += 1
+    return daa, freqs
+
+
+def upper_triangle_rates(daa: np.ndarray) -> np.ndarray:
+    """Same post-processing as the reference (`models.c:3010-3065`):
+    symmetrize, scale so the max exchangeability equals AA_SCALE, flatten the
+    upper triangle row-major."""
+    q = daa.reshape(20, 20).copy()
+    iu = np.triu_indices(20, 1)
+    q[iu] = q[(iu[1], iu[0])]      # tables store the lower triangle
+    vals = q[iu]
+    return vals * (AA_SCALE / vals.max())
+
+
+def main():
+    text = open(SRC).read()
+    start = text.index("static void initProtMat")
+    end = text.index("static void mytred2")
+    body = text[start:end]
+    blocks = case_blocks(body)
+    missing = [c for c in CASES if c not in blocks]
+    assert not missing, f"missing cases: {missing}"
+
+    out = {}
+    for name, block in blocks.items():
+        if name in ("LG4M", "LG4X"):
+            rates4, freqs4 = parse_lg4(block)
+            scaled = np.stack([r * (AA_SCALE / r.max()) for r in rates4])
+            out[f"{name}_rates"] = scaled
+            out[f"{name}_freqs"] = freqs4 / freqs4.sum(axis=1, keepdims=True)
+        else:
+            daa, f = parse_daa_f(block)
+            if daa.max() == 0.0:
+                daa, f = parse_flat_lower(block)
+            out[f"{name}_rates"] = upper_triangle_rates(daa)
+            out[f"{name}_freqs"] = f / f.sum()
+
+    np.savez_compressed(OUT, **out)
+    print(f"wrote {OUT}: {sorted(out)}")
+    for name in ("WAG", "LG"):
+        r, f = out[f"{name}_rates"], out[f"{name}_freqs"]
+        print(name, "rates[:4]", r[:4], "freqsum", f.sum())
+
+
+if __name__ == "__main__":
+    sys.exit(main())
