@@ -69,8 +69,10 @@ class PhyloInstance:
 
     # -- model push --------------------------------------------------------
 
-    def push_models(self) -> None:
+    def push_models(self, only_states=None) -> None:
         for states, bucket in self.buckets.items():
+            if only_states is not None and states not in only_states:
+                continue
             self.engines[states].set_models(
                 [self.models[g] for g in bucket.part_ids])
 
@@ -101,27 +103,39 @@ class PhyloInstance:
         entries = self._collect(tree, slot, full=False)
         self.run_traversal(entries)
 
-    def run_traversal(self, entries: List[TraversalEntry]) -> None:
+    def run_traversal(self, entries: List[TraversalEntry],
+                      only_states=None) -> None:
         if not entries:
             return
-        for eng in self.engines.values():
+        for states, eng in self.engines.items():
+            if only_states is not None and states not in only_states:
+                continue
             eng.run_traversal(entries)
 
     # -- likelihood --------------------------------------------------------
 
     def evaluate(self, tree: Tree, p: Optional[Node] = None,
-                 full: bool = False) -> float:
+                 full: bool = False, only_states=None) -> float:
         """lnL at branch (p, p.back); reference evaluateGeneric
-        (`evaluateGenericSpecial.c:897-1001`)."""
+        (`evaluateGenericSpecial.c:897-1001`).
+
+        only_states restricts traversal+evaluation to the named state
+        buckets (the reference's executeModel masking during model
+        optimization): other partitions keep their cached lnL, which stays
+        valid because their parameters and the tree are unchanged.  Callers
+        must finish with an unrestricted evaluate before changing topology.
+        """
         if p is None:
             p = tree.start
         q = p.back
         if full:
             tree.invalidate_all()
         entries = self._collect(tree, p, full) + self._collect(tree, q, full)
-        self.run_traversal(entries)
-        per_part = np.zeros(self.num_parts)
+        self.run_traversal(entries, only_states=only_states)
+        per_part = self.per_partition_lnl
         for states, eng in self.engines.items():
+            if only_states is not None and states not in only_states:
+                continue
             vals = eng.evaluate(p.number, q.number, p.z)
             for li, gid in enumerate(eng.bucket.part_ids):
                 per_part[gid] = vals[li]

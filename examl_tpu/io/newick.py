@@ -36,6 +36,9 @@ class _Parser:
         self.pos = 0
 
     def peek(self) -> str:
+        while (self.pos < len(self.text)
+               and self.text[self.pos] in " \t\n\r"):
+            self.pos += 1
         return self.text[self.pos] if self.pos < len(self.text) else ""
 
     def take(self) -> str:
@@ -69,11 +72,14 @@ class _Parser:
         if self.peek() == "'":
             self.take()
             out = []
-            while True:
-                ch = self.take()
+            while True:                      # raw access: keep inner spaces
+                ch = (self.text[self.pos]
+                      if self.pos < len(self.text) else "")
+                self.pos += 1
                 if ch == "'":
-                    if self.peek() == "'":
-                        out.append(self.take())
+                    if self.pos < len(self.text) and self.text[self.pos] == "'":
+                        out.append("'")
+                        self.pos += 1
                     else:
                         break
                 elif not ch:

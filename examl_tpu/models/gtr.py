@@ -54,15 +54,27 @@ def rates_to_matrix(rates: np.ndarray, states: int) -> np.ndarray:
     return R + R.T
 
 
+def sanitize_freqs(freqs: np.ndarray) -> np.ndarray:
+    """Clamp to FREQ_MIN and renormalize.  Applied ONCE when parameters are
+    installed into a ModelParams so the eigendecomposition and the kernels
+    (site likelihoods, sumtables) always see the same distribution."""
+    freqs = np.maximum(np.asarray(freqs, dtype=np.float64), FREQ_MIN)
+    return freqs / freqs.sum()
+
+
+def sanitize_rates(rates: np.ndarray) -> np.ndarray:
+    return np.clip(np.asarray(rates, dtype=np.float64), RATE_MIN, RATE_MAX)
+
+
 def eigen_gtr(rates: np.ndarray, freqs: np.ndarray):
     """Returns (eign, EV, EI) of the mean-rate-1 reversible generator.
 
     eign >= 0 are the negated eigenvalues sorted so eign[0] = 0.
+    Inputs are assumed sanitized (see sanitize_freqs/sanitize_rates).
     """
     states = len(freqs)
-    freqs = np.maximum(np.asarray(freqs, dtype=np.float64), FREQ_MIN)
-    freqs = freqs / freqs.sum()
-    rates = np.clip(np.asarray(rates, dtype=np.float64), RATE_MIN, RATE_MAX)
+    freqs = sanitize_freqs(freqs)
+    rates = sanitize_rates(rates)
     R = rates_to_matrix(rates, states)
     Q = R * freqs[None, :]
     np.fill_diagonal(Q, 0.0)
@@ -95,22 +107,23 @@ def build_model(dt: DataType, freqs: np.ndarray,
     states = dt.states
     if rates is None:
         rates = np.ones(n_exchange(states))
+    rates = sanitize_rates(rates)
+    freqs = sanitize_freqs(freqs)
     eign, ev, ei = eigen_gtr(rates, freqs)
     grates = gamma_category_rates(alpha, ncat, use_median)
-    return ModelParams(states=states, rates=np.asarray(rates, dtype=np.float64),
-                       freqs=np.asarray(freqs, dtype=np.float64), alpha=alpha,
+    return ModelParams(states=states, rates=rates, freqs=freqs, alpha=alpha,
                        gamma_rates=grates, eign=eign, ev=ev, ei=ei,
                        use_median=use_median)
 
 
 def with_rates(m: ModelParams, rates: np.ndarray) -> ModelParams:
+    rates = sanitize_rates(rates)
     eign, ev, ei = eigen_gtr(rates, m.freqs)
-    return replace(m, rates=np.asarray(rates, dtype=np.float64),
-                   eign=eign, ev=ev, ei=ei)
+    return replace(m, rates=rates, eign=eign, ev=ev, ei=ei)
 
 
 def with_freqs(m: ModelParams, freqs: np.ndarray) -> ModelParams:
-    freqs = np.asarray(freqs, dtype=np.float64)
+    freqs = sanitize_freqs(freqs)
     eign, ev, ei = eigen_gtr(m.rates, freqs)
     return replace(m, freqs=freqs, eign=eign, ev=ev, ei=ei)
 

@@ -1,0 +1,2 @@
+from examl_tpu.optimize.branch import (  # noqa: F401
+    update_branch, smooth_subtree, smooth_tree, local_smooth, tree_evaluate)

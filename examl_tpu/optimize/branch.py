@@ -1,0 +1,119 @@
+"""Branch-length smoothing passes over the tree.
+
+Semantics of the reference's `update`/`smooth`/`smoothTree`/`localSmooth`/
+`treeEvaluate` (ExaML `searchAlgo.c:127-436, 2635-2650`): repeated
+Newton-Raphson passes over every branch until no branch moves by more than
+`deltaz`, tracked per branch slot through the instance's
+`partition_smoothed` / `partition_converged` flags.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from examl_tpu.constants import DELTAZ, SMOOTHINGS
+from examl_tpu.instance import PhyloInstance
+from examl_tpu.tree.topology import Node, Tree
+
+
+def update_branch(inst: PhyloInstance, tree: Tree, p: Node) -> None:
+    """One-branch NR update + smoothed-flag bookkeeping (ref `update`)."""
+    q = p.back
+    z0 = np.asarray(q.z, dtype=np.float64)
+    if len(z0) != inst.num_branch_slots:
+        z0 = np.full(inst.num_branch_slots, z0[0])
+    z = inst.makenewz(tree, p, q, z0, maxiter=1,
+                      mask_converged=inst.num_branch_slots > 1)
+    moved = np.abs(z - z0) > DELTAZ
+    upd = ~inst.partition_converged
+    inst.partition_smoothed &= ~(upd & moved)
+    znew = np.where(upd, z, z0)
+    p.z[:] = znew.tolist()
+    q.z[:] = znew.tolist()
+
+
+def smooth_subtree(inst: PhyloInstance, tree: Tree, p: Node) -> None:
+    """Adjust branch (p, p.back) then recurse below p (ref `smooth`)."""
+    update_branch(inst, tree, p)
+    if not tree.is_tip(p.number):
+        for s in (p.next, p.next.next):
+            smooth_subtree(inst, tree, s.back)
+        inst.new_view(tree, p)
+
+
+def _all_smoothed(inst: PhyloInstance) -> bool:
+    result = True
+    for i in range(inst.num_branch_slots):
+        if not inst.partition_smoothed[i]:
+            result = False
+        else:
+            inst.partition_converged[i] = True
+    return result
+
+
+def smooth_tree(inst: PhyloInstance, tree: Tree, maxtimes: int) -> None:
+    """Smoothing passes over every branch (ref `smoothTree`).
+
+    tree.start is always tip 1, so one recursion from start.back covers
+    every branch (the reference's extra non-tip start case is unreachable
+    here)."""
+    p = tree.start
+    inst.partition_converged[:] = False
+    while maxtimes > 0:
+        maxtimes -= 1
+        inst.partition_smoothed[:] = True
+        smooth_subtree(inst, tree, p.back)
+        if _all_smoothed(inst):
+            break
+    inst.partition_converged[:] = False
+
+
+def local_smooth(inst: PhyloInstance, tree: Tree, p: Node,
+                 maxtimes: int) -> bool:
+    """Smooth only the three branches of inner node p (ref `localSmooth`)."""
+    if tree.is_tip(p.number):
+        return False
+    inst.partition_converged[:] = False
+    while maxtimes > 0:
+        maxtimes -= 1
+        inst.partition_smoothed[:] = True
+        for s in (p, p.next, p.next.next):
+            update_branch(inst, tree, s)
+        if _all_smoothed(inst):
+            break
+    inst.partition_smoothed[:] = False
+    inst.partition_converged[:] = False
+    return True
+
+
+def region_smooth(inst: PhyloInstance, tree: Tree, p: Node, region: int,
+                  maxtimes: int) -> bool:
+    """Smooth branches within `region` hops of branch (p, p.back)
+    (ref `regionalSmooth`, `searchAlgo.c:368-436`)."""
+    def smooth_region(s: Node, depth: int) -> None:
+        update_branch(inst, tree, s)
+        if depth > 0 and not tree.is_tip(s.number):
+            for t in (s.next, s.next.next):
+                smooth_region(t.back, depth - 1)
+            inst.new_view(tree, s)
+
+    if tree.is_tip(p.number) and tree.is_tip(p.back.number):
+        return False
+    inst.partition_converged[:] = False
+    while maxtimes > 0:
+        maxtimes -= 1
+        inst.partition_smoothed[:] = True
+        smooth_region(p, region)
+        smooth_region(p.back, region)
+        if _all_smoothed(inst):
+            break
+    inst.partition_smoothed[:] = False
+    inst.partition_converged[:] = False
+    return True
+
+
+def tree_evaluate(inst: PhyloInstance, tree: Tree,
+                  smooth_factor: float = 1.0) -> float:
+    """Smooth all branches then evaluate (ref `treeEvaluate`)."""
+    smooth_tree(inst, tree, int(SMOOTHINGS * smooth_factor))
+    return inst.evaluate(tree, tree.start, full=True)

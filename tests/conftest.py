@@ -17,6 +17,9 @@ if "xla_force_host_platform_device_count" not in _flags:
 
 import jax  # noqa: E402
 
+# Belt and braces: the axon sitecustomize may have imported jax before this
+# file ran, in which case the env var alone is too late.
+jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
 
 import pytest  # noqa: E402,F401

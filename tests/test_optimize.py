@@ -1,0 +1,60 @@
+"""Branch smoothing and model optimization tests."""
+
+import numpy as np
+import pytest
+
+from examl_tpu.instance import PhyloInstance
+from examl_tpu.io.alignment import load_alignment
+from examl_tpu.optimize.branch import tree_evaluate
+from examl_tpu.optimize.model_opt import mod_opt, opt_alphas, opt_rates
+
+from tests.conftest import TESTDATA
+
+
+@pytest.fixture(scope="module")
+def setup49():
+    ad = load_alignment(f"{TESTDATA}/49", f"{TESTDATA}/49.model")
+    inst = PhyloInstance(ad)
+    tree = inst.tree_from_newick(open(f"{TESTDATA}/49.tree").read())
+    return inst, tree
+
+
+def test_tree_evaluate_improves_and_converges(setup49):
+    inst, tree = setup49
+    lnl0 = inst.evaluate(tree, full=True)
+    lnl1 = tree_evaluate(inst, tree, 1.0)
+    assert lnl1 > lnl0
+    lnl2 = tree_evaluate(inst, tree, 0.25)
+    assert abs(lnl2 - lnl1) < 1e-4
+
+
+def test_mod_opt_improves_monotonically(setup49):
+    inst, tree = setup49
+    lnl0 = inst.evaluate(tree, full=True)
+    opt_alphas(inst, tree)
+    lnl_a = inst.likelihood
+    assert lnl_a >= lnl0 - 1e-9
+    opt_rates(inst, tree)
+    lnl_r = inst.likelihood
+    assert lnl_r >= lnl_a - 1e-9
+    lnl = mod_opt(inst, tree, 5.0, max_rounds=3)
+    assert lnl >= lnl_r - 1e-9
+    # Optimized alphas should be in a sensible range for real rRNA/mtDNA data
+    for m in inst.models:
+        assert 0.02 <= m.alpha <= 5.0
+
+
+def test_brent_vectorized_quadratics():
+    """Pure-numpy check: minimize G independent shifted quadratics."""
+    from examl_tpu.optimize.brent import minimize_vector
+    centers = np.array([0.3, 1.7, 4.2, 0.9])
+
+    def fn(xs):
+        return (xs - centers) ** 2
+
+    x0 = np.ones_like(centers)
+    xb, fb = minimize_vector(x0, np.full(4, 0.01), np.full(4, 10.0), fn, 1e-6)
+    assert np.allclose(xb, centers, atol=1e-3), xb
+    # Bound-constrained: optimum outside the box clamps to the bound
+    xb2, _ = minimize_vector(x0, np.full(4, 2.0), np.full(4, 10.0), fn, 1e-6)
+    assert np.allclose(xb2[:2], 2.0, atol=1e-3) and abs(xb2[2] - 4.2) < 1e-3
