@@ -1,0 +1,110 @@
+"""Benchmark: site-CLV updates/sec/chip on the 140-taxon AA test set.
+
+North-star metric from BASELINE.json: CLV (newview) update throughput on
+`/root/reference/testData/140` (GTR-family 20-state GAMMA), measured as
+  traversal entries x pattern count x rates x states / wall second
+over dependency-chained full-tree traversals (each step consumes the
+previous step's CLV buffer, so device pipelining cannot overlap steps).
+Equivalent reference loop: `newviewIterative` over a full traversal
+(`newviewGenericSpecial.c:917-1515`).
+
+vs_baseline compares against one AVX socket of the reference build; the
+number comes from tools/avx_baseline.json when the measurement harness
+(tools/bench_reference.py) has been run, else a conservative estimate.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.abspath(__file__))
+DATA = "/root/reference/testData"
+# Conservative single-socket AVX estimate until tools/bench_reference.py
+# measures the real number on this host (writes tools/avx_baseline.json).
+FALLBACK_AVX_UPDATES_PER_SEC = 2.0e9
+
+
+def _load_instance():
+    import jax.numpy as jnp
+
+    from examl_tpu.instance import PhyloInstance, default_instance
+
+    phy = os.path.join(DATA, "140")
+    mod = os.path.join(DATA, "140.model")
+    if os.path.exists(phy):
+        inst = default_instance(phy, mod, dtype=jnp.float64)
+        tree = inst.tree_from_newick(open(os.path.join(DATA, "140.tree")).read())
+        return inst, tree, "testData/140"
+    # Fallback synthetic AA set with the same shape.
+    from examl_tpu.io.alignment import build_alignment_data
+    rng = np.random.default_rng(0)
+    aas = "ARNDCQEGHILKMFPSTWYV"
+    names = [f"t{i}" for i in range(140)]
+    seqs = ["".join(aas[c] for c in rng.integers(0, 20, 1104))
+            for _ in names]
+    ad = build_alignment_data(names, seqs, datatype_name="AA")
+    inst = PhyloInstance(ad, dtype=jnp.float64)
+    return inst, inst.random_tree(0), "synthetic-140"
+
+
+def main() -> None:
+    import jax
+
+    jax.config.update("jax_enable_x64", True)
+    inst, tree, dataset = _load_instance()
+    lnl = inst.evaluate(tree, full=True)
+
+    eng = inst.engines[20]
+    _, entries = tree.full_traversal()
+    tv = eng._traversal_arrays(entries)
+    clv, scaler = eng.clv, eng.scaler
+
+    def step(clv, scaler):
+        return eng._jit_traverse(clv, scaler, tv, eng.models, eng.block_part)
+
+    clv, scaler = step(clv, scaler)          # compile + warm
+    jax.block_until_ready(scaler)
+    n_steps = 50
+    t0 = time.perf_counter()
+    for _ in range(n_steps):
+        clv, scaler = step(clv, scaler)      # chained: no cross-step overlap
+    jax.block_until_ready(scaler)
+    dt = time.perf_counter() - t0
+
+    patterns = sum(p.width for p in inst.alignment.partitions)
+    rates, states = eng.R, eng.K
+    updates = n_steps * len(entries) * patterns * rates * states
+    ups = updates / dt
+
+    base_path = os.path.join(REPO, "tools", "avx_baseline.json")
+    if os.path.exists(base_path):
+        with open(base_path) as f:
+            base = json.load(f)
+        avx = float(base["site_clv_updates_per_sec"])
+        base_src = base.get("source", "measured")
+    else:
+        avx = FALLBACK_AVX_UPDATES_PER_SEC
+        base_src = "estimate"
+
+    print(json.dumps({
+        "metric": "site_clv_updates_per_sec",
+        "value": round(ups, 1),
+        "unit": "updates/s",
+        "vs_baseline": round(ups / avx, 3),
+        "dataset": dataset,
+        "dtype": "float64",
+        "lnl": round(float(lnl), 6),
+        "ms_per_traversal": round(dt / n_steps * 1000, 3),
+        "baseline_source": base_src,
+        "backend": jax.default_backend(),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -39,10 +39,15 @@ class PhyloInstance:
         # Initial models (reference initModel `models.c:4180`): GTR rates all
         # 1.0, empirical frequencies (or the protein matrix's own), alpha 1.0.
         self.models: List[ModelParams] = []
-        for part in alignment.partitions:
+        # AUTO partitions start from WAG (reference `models.c:4222`) until
+        # autoProtein selection replaces them during modOpt.
+        self.auto_prot_models: Dict[int, str] = {
+            gid: "WAG" for gid, p in enumerate(alignment.partitions) if p.auto}
+        for gid, part in enumerate(alignment.partitions):
             rates, freqs = None, part.empirical_freqs
-            if part.datatype.name == "AA" and part.model_name != "GTR":
-                rates, model_freqs = protein_mod.get_matrix(part.model_name)
+            name = self.auto_prot_models.get(gid, part.model_name)
+            if part.datatype.name == "AA" and name != "GTR":
+                rates, model_freqs = protein_mod.get_matrix(name)
                 if not part.use_empirical_freqs and not part.optimize_freqs:
                     freqs = model_freqs
             self.models.append(build_model(
@@ -139,7 +144,10 @@ class PhyloInstance:
             vals = eng.evaluate(p.number, q.number, p.z)
             for li, gid in enumerate(eng.bucket.part_ids):
                 per_part[gid] = vals[li]
-        self.per_partition_lnl = per_part
+        if only_states is not None and np.isnan(per_part).any():
+            raise RuntimeError(
+                "restricted evaluate before any unrestricted one: cached "
+                "per-partition lnL is uninitialized for the skipped buckets")
         self.likelihood = float(per_part.sum())
         return self.likelihood
 

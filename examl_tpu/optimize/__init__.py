@@ -1,2 +1,3 @@
 from examl_tpu.optimize.branch import (  # noqa: F401
-    update_branch, smooth_subtree, smooth_tree, local_smooth, tree_evaluate)
+    update_branch, smooth_subtree, smooth_tree, local_smooth, region_smooth,
+    tree_evaluate)

@@ -38,7 +38,8 @@ def _opt_param(inst: PhyloInstance, tree: Tree, groups: Sequence[List[int]],
                get0: Callable[[int], float],
                setv: Callable[[int, float], None],
                lim_inf: float, lim_sup: float,
-               tol: float = MODEL_EPSILON, only_states=None) -> None:
+               tol: float = MODEL_EPSILON, only_states=None,
+               coherent: bool = False) -> None:
     """Optimize one scalar parameter per linkage group by batched Brent.
 
     get0(gid) reads the current value from partition gid; setv(gid, v)
@@ -46,10 +47,13 @@ def _opt_param(inst: PhyloInstance, tree: Tree, groups: Sequence[List[int]],
     Accept-if-improved per group, as the reference's optParamGeneric.
     Brent probes touch only the affected state buckets (only_states);
     the final evaluate is unrestricted so all engines end coherent.
+    coherent=True promises per_partition_lnl already matches the current
+    models+tree (skips the leading full evaluate).
     """
     if not groups:
         return
-    inst.evaluate(tree, full=True)
+    if not coherent:
+        inst.evaluate(tree, full=True)
     start_lnl = _group_lnl(inst, groups)
     x0 = np.array([get0(grp[0]) for grp in groups])
 
@@ -110,7 +114,7 @@ def opt_rates(inst: PhyloInstance, tree: Tree,
                 inst.models[gid] = with_rates(m, rates)
 
             _opt_param(inst, tree, groups, get0, setv, RATE_MIN, RATE_MAX,
-                       tol, only_states={states})
+                       tol, only_states={states}, coherent=k > 0)
 
 
 def opt_alphas(inst: PhyloInstance, tree: Tree,
@@ -148,7 +152,8 @@ def opt_freqs(inst: PhyloInstance, tree: Tree,
                 inst.models[gid] = with_freqs(inst.models[gid], freqs)
 
             _opt_param(inst, tree, groups, get0, setv,
-                       FREQ_EXP_MIN, FREQ_EXP_MAX, tol, only_states={states})
+                       FREQ_EXP_MIN, FREQ_EXP_MAX, tol, only_states={states},
+                       coherent=k > 0)
 
 
 def mod_opt(inst: PhyloInstance, tree: Tree, likelihood_epsilon: float,

@@ -1,0 +1,79 @@
+"""Device-mesh sharding of the packed site axis.
+
+TPU-native replacement for the reference's MPI rank layout (ExaML
+`partitionAssignment.c` + `communication.c`): instead of assigning site
+chunks to ranks, the packed block axis produced by `parallel/packing.py` is
+sharded uniformly over a 1-D `jax.sharding.Mesh` axis ("sites").  Model
+tensors and the traversal descriptor stay replicated — exactly the
+reference's design, where every rank holds the whole tree and model and
+only per-site state is distributed.  The per-partition lnL / derivative
+reductions (`MPI_Allreduce` at `evaluateGenericSpecial.c:968-973` and
+`makenewzGenericSpecial.c:1241-1248`) need no explicit collective here:
+the segment sums over the sharded block axis make XLA insert the
+all-reduce over ICI.
+
+Multi-host scale-out uses the same mesh: `jax.distributed` process groups
+present a global device list, and the "sites" axis spans all chips; the
+only cross-host traffic is the small lnL reduction, riding DCN exactly as
+the reference's Allreduce rides the interconnect.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+SITE_AXIS = "sites"
+
+
+@dataclass
+class SiteSharding:
+    """NamedShardings for each engine tensor layout, all over one mesh axis.
+
+    Attribute names match what `LikelihoodEngine.apply_sharding` consumes:
+      clv     [rows, B, lane, R, K]  — blocks on axis 1
+      scaler  [rows, B, lane]        — blocks on axis 1
+      sites   [B, lane]              — blocks on axis 0 (weights)
+      blocks  [B]                    — blocks on axis 0 (block_part)
+      replicated                     — models / traversal descriptors
+    """
+    mesh: Mesh
+    clv: NamedSharding
+    scaler: NamedSharding
+    sites: NamedSharding
+    blocks: NamedSharding
+    replicated: NamedSharding
+
+    @property
+    def num_devices(self) -> int:
+        return self.mesh.devices.size
+
+
+def make_mesh(devices: Optional[Sequence[jax.Device]] = None,
+              n_devices: Optional[int] = None) -> Mesh:
+    """1-D mesh over the site axis (the framework's only sharded axis,
+    mirroring the reference's single data-parallel strategy, SURVEY §2.3)."""
+    if devices is None:
+        devices = jax.devices()
+    if n_devices is not None:
+        devices = devices[:n_devices]
+    return Mesh(np.asarray(devices), (SITE_AXIS,))
+
+
+def site_sharding(mesh: Mesh) -> SiteSharding:
+    return SiteSharding(
+        mesh=mesh,
+        clv=NamedSharding(mesh, P(None, SITE_AXIS)),
+        scaler=NamedSharding(mesh, P(None, SITE_AXIS)),
+        sites=NamedSharding(mesh, P(SITE_AXIS)),
+        blocks=NamedSharding(mesh, P(SITE_AXIS)),
+        replicated=NamedSharding(mesh, P()),
+    )
+
+
+def default_site_sharding(n_devices: Optional[int] = None) -> SiteSharding:
+    return site_sharding(make_mesh(n_devices=n_devices))
