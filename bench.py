@@ -31,14 +31,12 @@ FALLBACK_AVX_UPDATES_PER_SEC = 2.0e9
 
 
 def _load_instance():
-    import jax.numpy as jnp
-
     from examl_tpu.instance import PhyloInstance, default_instance
 
     phy = os.path.join(DATA, "140")
     mod = os.path.join(DATA, "140.model")
     if os.path.exists(phy):
-        inst = default_instance(phy, mod, dtype=jnp.float64)
+        inst = default_instance(phy, mod)    # auto dtype: f32 on TPU
         tree = inst.tree_from_newick(open(os.path.join(DATA, "140.tree")).read())
         return inst, tree, "testData/140"
     # Fallback synthetic AA set with the same shape.
@@ -49,7 +47,7 @@ def _load_instance():
     seqs = ["".join(aas[c] for c in rng.integers(0, 20, 1104))
             for _ in names]
     ad = build_alignment_data(names, seqs, datatype_name="AA")
-    inst = PhyloInstance(ad, dtype=jnp.float64)
+    inst = PhyloInstance(ad)
     return inst, inst.random_tree(0), "synthetic-140"
 
 
@@ -60,21 +58,28 @@ def main() -> None:
     inst, tree, dataset = _load_instance()
     lnl = inst.evaluate(tree, full=True)
 
+    import jax.numpy as jnp
+
+    from examl_tpu.ops import kernels
+
     eng = inst.engines[20]
     _, entries = tree.full_traversal()
     tv = eng._traversal_arrays(entries)
-    clv, scaler = eng.clv, eng.scaler
-
-    def step(clv, scaler):
-        return eng._jit_traverse(clv, scaler, tv, eng.models, eng.block_part)
-
-    clv, scaler = step(clv, scaler)          # compile + warm
-    jax.block_until_ready(scaler)
     n_steps = 50
+
+    # n_steps dependency-chained traversals inside ONE jit returning a
+    # scalar: immune to async-dispatch/transfer artifacts of the TPU tunnel.
+    @jax.jit
+    def chained(clv, scaler):
+        def body(_, cs):
+            return kernels.traverse(eng.models, eng.block_part, cs[0], cs[1],
+                                    tv, eng.scale_exp)
+        clv, scaler = jax.lax.fori_loop(0, n_steps, body, (clv, scaler))
+        return jnp.sum(scaler)
+
+    float(chained(eng.clv, eng.scaler))      # compile + warm
     t0 = time.perf_counter()
-    for _ in range(n_steps):
-        clv, scaler = step(clv, scaler)      # chained: no cross-step overlap
-    jax.block_until_ready(scaler)
+    float(chained(eng.clv, eng.scaler))
     dt = time.perf_counter() - t0
 
     patterns = sum(p.width for p in inst.alignment.partitions)
@@ -98,7 +103,7 @@ def main() -> None:
         "unit": "updates/s",
         "vs_baseline": round(ups / avx, 3),
         "dataset": dataset,
-        "dtype": "float64",
+        "dtype": str(eng.dtype),
         "lnl": round(float(lnl), 6),
         "ms_per_traversal": round(dt / n_steps * 1000, 3),
         "baseline_source": base_src,

@@ -5,6 +5,20 @@ from __future__ import annotations
 import jax
 
 
+def default_dtype():
+    """Engine compute dtype: f64 on CPU (reference-grade parity), f32 on
+    TPU (MXU-native; einsums run at Precision.HIGHEST and final reductions
+    accumulate in f64, landing within ~1e-6 relative of the f64 lnL).
+
+    f64 is only chosen when x64 is actually live — otherwise JAX silently
+    materializes f32 arrays while scale_exponent=256 assumes f64 range,
+    which would disable CLV rescaling entirely."""
+    import jax.numpy as jnp
+    if jax.default_backend() == "cpu" and jax.config.jax_enable_x64:
+        return jnp.float64
+    return jnp.float32
+
+
 def enable_x64() -> None:
     """Enable float64 in JAX (required for dtype=float64 engines).
 

@@ -23,12 +23,13 @@ from examl_tpu.tree.topology import Node, Tree, TraversalEntry
 
 
 class PhyloInstance:
-    def __init__(self, alignment: AlignmentData, dtype=jnp.float64,
+    def __init__(self, alignment: AlignmentData, dtype=None,
                  ncat: int = 4, use_median: bool = False,
                  per_partition_branches: bool = False,
                  block_multiple: int = 1, sharding=None):
+        from examl_tpu.config import default_dtype
         self.alignment = alignment
-        self.dtype = jnp.dtype(dtype)
+        self.dtype = jnp.dtype(dtype if dtype is not None else default_dtype())
         self.ncat = ncat
         self.use_median = use_median
         M = len(alignment.partitions)
@@ -64,7 +65,8 @@ class PhyloInstance:
             self.engines[states] = LikelihoodEngine(
                 bucket, [self.models[g] for g in bucket.part_ids],
                 alignment.ntaxa, num_branch_slots=self.num_branch_slots,
-                branch_indices=branch_indices, dtype=dtype, sharding=sharding)
+                branch_indices=branch_indices, dtype=self.dtype,
+                sharding=sharding)
 
         self.per_partition_lnl = np.full(M, np.nan)
         self.likelihood = np.nan

@@ -3,9 +3,10 @@
 One engine instance manages one state-count bucket (see parallel/packing.py):
 the CLV tensor `[rows, blocks, lane, rates, states]`, the per-(row, site)
 scaling exponents, and jit-compiled traversal / root-evaluation / derivative
-programs.  Traversal programs are compiled per power-of-two entry count so
-partial traversals (typically 3-4 entries, reference
-`newviewGenericSpecial.c:925`) reuse a handful of compiled variants.
+programs.  Traversal programs are compiled per wave-schedule shape [L, W]
+(W a capped power of two, L a multiple of 4) so partial traversals
+(typically 3-4 entries, reference `newviewGenericSpecial.c:925`) and full
+traversals each reuse a handful of compiled variants.
 
 CLV rows are indexed by tree-node number - 1 (tips 1..n hold their constant
 tip indicator vectors, inner nodes n+1..2n-2 are recomputed on traversal);
@@ -56,13 +57,14 @@ class LikelihoodEngine:
                  ntips: int, num_branch_slots: int = 1,
                  branch_indices: Optional[Sequence[int]] = None,
                  dtype=jnp.float64, sharding=None,
-                 scale_exp: Optional[int] = None):
+                 scale_exp: Optional[int] = None, wave_width: int = 8):
         self.bucket = bucket
         self.ntips = ntips
         self.dtype = jnp.dtype(dtype)
         self.scale_exp = (scale_exp if scale_exp is not None
                           else kernels.default_scale_exponent(self.dtype))
         self.num_branch_slots = num_branch_slots
+        self.wave_width = wave_width
         self.num_parts = bucket.num_parts
         self.num_rows = 2 * ntips - 1          # node rows + 1 scratch
         self.scratch_row = self.num_rows - 1
@@ -136,19 +138,34 @@ class LikelihoodEngine:
     # -- traversal ---------------------------------------------------------
 
     def _traversal_arrays(self, entries: List[TraversalEntry]) -> Traversal:
-        E = _next_pow2(max(len(entries), 1))
+        """Wave-schedule entries into [L, W] with a capped wave width.
+
+        Waves wider than `wave_width` are chunked over several steps (their
+        entries are independent, so any split is valid); narrow waves pad to
+        W.  This keeps padding waste ~W/2 entries per wave while collapsing
+        the sequential step count from len(entries) to ~len(waves).  L and W
+        are powers of two so only a handful of compiled variants exist."""
+        from examl_tpu.tree.topology import Tree
+        raw = Tree.schedule_waves(entries)
+        cap = self.wave_width
+        W = min(_next_pow2(max((len(w) for w in raw), default=1)), cap)
+        waves = [w[i:i + W] for w in raw for i in range(0, len(w), W)]
+        # L pads to a multiple of 4 (not pow2): a padding wave costs a full
+        # W-wide newview, so pow2 rounding could nearly double step count.
+        L = max(4 * ((len(waves) + 3) // 4), 4)
         C = self.num_branch_slots
-        parent = np.full(E, self.scratch_row, dtype=np.int32)
-        left = np.zeros(E, dtype=np.int32)
-        right = np.zeros(E, dtype=np.int32)
-        zl = np.ones((E, C), dtype=np.float64)
-        zr = np.ones((E, C), dtype=np.float64)
-        for i, e in enumerate(entries):
-            parent[i] = e.parent - 1
-            left[i] = e.left - 1
-            right[i] = e.right - 1
-            zl[i, :] = _z_slots(e.zl, C)
-            zr[i, :] = _z_slots(e.zr, C)
+        parent = np.full((L, W), self.scratch_row, dtype=np.int32)
+        left = np.zeros((L, W), dtype=np.int32)
+        right = np.zeros((L, W), dtype=np.int32)
+        zl = np.ones((L, W, C), dtype=np.float64)
+        zr = np.ones((L, W, C), dtype=np.float64)
+        for li, wave in enumerate(waves):
+            for wi, e in enumerate(wave):
+                parent[li, wi] = e.parent - 1
+                left[li, wi] = e.left - 1
+                right[li, wi] = e.right - 1
+                zl[li, wi, :] = _z_slots(e.zl, C)
+                zr[li, wi, :] = _z_slots(e.zr, C)
         return Traversal(parent=jnp.asarray(parent), left=jnp.asarray(left),
                          right=jnp.asarray(right),
                          zl=jnp.asarray(zl, dtype=self.dtype),

@@ -21,10 +21,29 @@ float32.
 
 from __future__ import annotations
 
+import functools
 from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
+
+# All contractions run at full input precision: on TPU the MXU otherwise
+# truncates f32 operands to bf16, which costs ~4 decimal digits of CLV
+# accuracy — far outside the reference-parity budget.  HIGHEST keeps f32
+# einsums exact (multi-pass) and is a no-op for f64/CPU.
+einsum = functools.partial(jnp.einsum, precision=jax.lax.Precision.HIGHEST)
+
+
+def _acc_dtype(dtype) -> jnp.dtype:
+    """Accumulator dtype for site sums: f64 when x64 is live, else f32.
+
+    Per-site values are fine in f32, but summing O(10^5)-magnitude lnL over
+    many sites in f32 loses ~1e-2 absolute; the (cheap, elementwise) final
+    reductions therefore accumulate in f64 whenever available.
+    """
+    if jnp.dtype(dtype) == jnp.float64 or jax.config.jax_enable_x64:
+        return jnp.dtype(jnp.float64)
+    return jnp.dtype(dtype)
 
 
 class DeviceModels(NamedTuple):
@@ -39,12 +58,18 @@ class DeviceModels(NamedTuple):
 
 
 class Traversal(NamedTuple):
-    """Fixed-size padded traversal descriptor (host-built)."""
-    parent: jax.Array       # [E] int32 CLV row
-    left: jax.Array         # [E] int32
-    right: jax.Array        # [E] int32
-    zl: jax.Array           # [E, C] branch z to left child
-    zr: jax.Array           # [E, C]
+    """Fixed-size padded traversal descriptor (host-built).
+
+    Entries are wave-scheduled (`Tree.schedule_waves`): axis 0 runs over
+    dependency waves executed sequentially, axis 1 over the independent
+    entries of a wave executed as one batched newview.  Padding entries
+    point children at row 0 and the parent at the scratch row.
+    """
+    parent: jax.Array       # [L, W] int32 CLV row
+    left: jax.Array         # [L, W] int32
+    right: jax.Array        # [L, W] int32
+    zl: jax.Array           # [L, W, C] branch z to left child
+    zr: jax.Array           # [L, W, C]
 
 
 def default_scale_exponent(dtype, backend: str | None = None) -> int:
@@ -87,50 +112,63 @@ def branch_decay(models: DeviceModels, z: jax.Array) -> jax.Array:
 def p_matrices(models: DeviceModels, z: jax.Array) -> jax.Array:
     """P[m, r, a, k] = sum_j ev[a,j] d[j] ei[j,k] — dense per-partition P."""
     d = branch_decay(models, z)
-    return jnp.einsum("maj,mrj,mjk->mrak", models.ev, d, models.ei)
+    return einsum("maj,mrj,mjk->mrak", models.ev, d, models.ei)
 
 
 def apply_p(pmat: jax.Array, block_part: jax.Array, x: jax.Array) -> jax.Array:
     """y[b,l,r,a] = sum_k P[part(b),r,a,k] * x[b,l,r,k]."""
     pb = pmat[block_part]                                   # [B, R, K, K]
-    return jnp.einsum("brak,blrk->blra", pb, x)
+    return einsum("brak,blrk->blra", pb, x)
 
 
-def newview_block(models: DeviceModels, block_part: jax.Array,
-                  xl: jax.Array, xr: jax.Array,
-                  zl: jax.Array, zr: jax.Array, scale_exp: int):
-    """Combine two child CLVs into the parent CLV (one traversal entry).
+def p_matrices_wave(models: DeviceModels, z: jax.Array) -> jax.Array:
+    """P[w, m, r, a, k] for one wave of branch vectors z [W, C]."""
+    d = jax.vmap(lambda zz: branch_decay(models, zz))(z)    # [W, M, R, K]
+    return einsum("maj,wmrj,mjk->wmrak", models.ev, d, models.ei)
 
-    xl, xr: [B, lane, R, K].  Returns (clv [B,lane,R,K], scale_inc [B,lane]).
-    Reference semantics: `newviewGAMMA_FLEX` (`newviewGenericSpecial.c:430-682`).
+
+def newview_wave(models: DeviceModels, block_part: jax.Array,
+                 xl: jax.Array, xr: jax.Array,
+                 zl: jax.Array, zr: jax.Array, scale_exp: int):
+    """Combine child CLVs into parent CLVs for one wave of W entries.
+
+    xl, xr: [W, B, lane, R, K]; zl, zr: [W, C].
+    Returns (clv [W,B,lane,R,K], scale_inc [W,B,lane]).
+    Reference semantics: `newviewGAMMA_FLEX` (`newviewGenericSpecial.c:430-682`),
+    batched over independent traversal entries.
     """
-    yl = apply_p(p_matrices(models, zl), block_part, xl)
-    yr = apply_p(p_matrices(models, zr), block_part, xr)
+    pl = p_matrices_wave(models, zl)[:, block_part]         # [W, B, R, K, K]
+    pr = p_matrices_wave(models, zr)[:, block_part]
+    yl = einsum("wbrak,wblrk->wblra", pl, xl)
+    yr = einsum("wbrak,wblrk->wblra", pr, xr)
     v = yl * yr
     minlik, two_e, _ = scale_constants(v.dtype, scale_exp)
-    vmax = jnp.max(jnp.abs(v), axis=(2, 3))                 # [B, lane]
+    vmax = jnp.max(jnp.abs(v), axis=(3, 4))                 # [W, B, lane]
     needs = vmax < minlik
-    v = jnp.where(needs[:, :, None, None], v * two_e, v)
+    v = jnp.where(needs[:, :, :, None, None], v * two_e, v)
     return v, needs.astype(jnp.int32)
 
 
 def traverse(models: DeviceModels, block_part: jax.Array,
              clv: jax.Array, scaler: jax.Array, tv: Traversal,
              scale_exp: int):
-    """Execute a padded traversal descriptor as a lax.scan over entries.
+    """Execute a wave-scheduled traversal: lax.scan over waves, each wave a
+    batched newview over its independent entries.
 
     clv: [N, B, lane, R, K]; scaler: [N, B, lane] int32.
-    Padding entries must write to a scratch row (host sets parent=N-1).
+    Padding entries write to the scratch row (host sets parent=N-1); within
+    a wave the scatter indices are unique except for scratch duplicates,
+    whose value is never read.
     Reference: `newviewIterative` (`newviewGenericSpecial.c:917-1515`).
     """
     def body(carry, e):
         clv, scaler = carry
         parent, left, right, zl, zr = e
-        v, inc = newview_block(models, block_part, clv[left], clv[right],
-                               zl, zr, scale_exp)
-        sc = scaler[left] + scaler[right] + inc
-        clv = clv.at[parent].set(v)
-        scaler = scaler.at[parent].set(sc)
+        v, inc = newview_wave(models, block_part, clv[left], clv[right],
+                              zl, zr, scale_exp)
+        sc = scaler[left] + scaler[right] + inc             # [W, B, lane]
+        clv = clv.at[parent].set(v, unique_indices=False)
+        scaler = scaler.at[parent].set(sc, unique_indices=False)
         return (clv, scaler), None
 
     (clv, scaler), _ = jax.lax.scan(
@@ -149,7 +187,7 @@ def site_likelihoods(models: DeviceModels, block_part: jax.Array,
     y = apply_p(p_matrices(models, z), block_part, xq)      # [B,l,R,K]
     fb = models.freqs[block_part]                           # [B, K]
     wb = models.rate_weights[block_part]                    # [B, R]
-    return jnp.einsum("bk,br,blrk,blrk->bl", fb, wb, xp, y)
+    return einsum("bk,br,blrk,blrk->bl", fb, wb, xp, y)
 
 
 def root_log_likelihood(models: DeviceModels, block_part: jax.Array,
@@ -164,10 +202,12 @@ def root_log_likelihood(models: DeviceModels, block_part: jax.Array,
     segment/jnp sum over the sharded block axis (XLA inserts the collective).
     """
     lsite = site_likelihoods(models, block_part, clv[p_row], clv[q_row], z)
-    _, _, log_min = scale_constants(lsite.dtype, scale_exp)
-    sc = (scaler[p_row] + scaler[q_row]).astype(lsite.dtype)
+    acc = _acc_dtype(lsite.dtype)
+    _, _, log_min = scale_constants(acc, scale_exp)
+    sc = (scaler[p_row] + scaler[q_row]).astype(acc)
     lsite = jnp.maximum(lsite, jnp.finfo(lsite.dtype).tiny)
-    site_lnl = weights * (jnp.log(lsite) + sc * log_min)    # [B, lane]
+    site_lnl = weights.astype(acc) * (jnp.log(lsite).astype(acc)
+                                      + sc * log_min)       # [B, lane]
     block_lnl = jnp.sum(site_lnl, axis=1)                   # [B]
     return jax.ops.segment_sum(block_lnl, block_part, num_segments=num_parts)
 
@@ -184,8 +224,8 @@ def sumtable(models: DeviceModels, block_part: jax.Array,
     evb = models.ev[block_part]                             # [B, K, K]
     eib = models.ei[block_part]
     fb = models.freqs[block_part]
-    ap = jnp.einsum("bk,blrk,bkj->blrj", fb, xp, evb)
-    bq = jnp.einsum("bjk,blrk->blrj", eib, xq)
+    ap = einsum("bk,blrk,bkj->blrj", fb, xp, evb)
+    bq = einsum("bjk,blrk->blrj", eib, xq)
     return ap * bq
 
 
@@ -203,15 +243,17 @@ def nr_derivatives(models: DeviceModels, block_part: jax.Array,
     db = d[block_part]                                      # [B, R, K]
     e1b = e1[block_part]
 
-    lsite = jnp.einsum("br,blrj,brj->bl", wb, st, db)
-    dsite = jnp.einsum("br,blrj,brj,brj->bl", wb, st, db, e1b)
-    d2site = jnp.einsum("br,blrj,brj,brj,brj->bl", wb, st, db, e1b, e1b)
+    lsite = einsum("br,blrj,brj->bl", wb, st, db)
+    dsite = einsum("br,blrj,brj,brj->bl", wb, st, db, e1b)
+    d2site = einsum("br,blrj,brj,brj,brj->bl", wb, st, db, e1b, e1b)
 
     lsite = jnp.maximum(lsite, jnp.finfo(lsite.dtype).tiny)
-    dlnl = dsite / lsite
-    d2lnl = d2site / lsite - dlnl * dlnl
-    blk_d1 = jnp.sum(weights * dlnl, axis=1)
-    blk_d2 = jnp.sum(weights * d2lnl, axis=1)
+    acc = _acc_dtype(lsite.dtype)
+    dlnl = (dsite / lsite).astype(acc)
+    d2lnl = (d2site / lsite).astype(acc) - dlnl * dlnl
+    wacc = weights.astype(acc)
+    blk_d1 = jnp.sum(wacc * dlnl, axis=1)
+    blk_d2 = jnp.sum(wacc * d2lnl, axis=1)
     per_part_d1 = jax.ops.segment_sum(blk_d1, block_part,
                                       num_segments=models.eign.shape[0])
     per_part_d2 = jax.ops.segment_sum(blk_d2, block_part,

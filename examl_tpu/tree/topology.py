@@ -210,6 +210,27 @@ class Tree:
         rec(p)
         return entries
 
+    @staticmethod
+    def schedule_waves(entries: List[TraversalEntry]) -> List[List[TraversalEntry]]:
+        """Group a post-order traversal into dependency waves.
+
+        Wave k contains entries whose children are tips, stale-free CLVs, or
+        parents of waves < k (ASAP level scheduling).  All entries of one
+        wave are independent, so the device executes them as one batched
+        newview step — the TPU replacement for the reference's strictly
+        sequential traversal replay (`newviewIterative`,
+        `newviewGenericSpecial.c:917-1515`).
+        """
+        level: Dict[int, int] = {}
+        waves: List[List[TraversalEntry]] = []
+        for e in entries:
+            lv = max(level.get(e.left, 0), level.get(e.right, 0))
+            level[e.parent] = lv + 1
+            if lv == len(waves):
+                waves.append([])
+            waves[lv].append(e)
+        return waves
+
     def full_traversal(self) -> Tuple[Node, List[TraversalEntry]]:
         """Traversal making both ends of the branch at `start` valid."""
         p = self.start.back
