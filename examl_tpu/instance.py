@@ -138,12 +138,12 @@ class PhyloInstance:
         if full:
             tree.invalidate_all()
         entries = self._collect(tree, p, full) + self._collect(tree, q, full)
-        self.run_traversal(entries, only_states=only_states)
         per_part = self.per_partition_lnl
         for states, eng in self.engines.items():
             if only_states is not None and states not in only_states:
                 continue
-            vals = eng.evaluate(p.number, q.number, p.z)
+            # Fused traversal + root evaluation: one dispatch per engine.
+            vals = eng.traverse_evaluate(entries, p.number, q.number, p.z)
             for li, gid in enumerate(eng.bucket.part_ids):
                 per_part[gid] = vals[li]
         if only_states is not None and np.isnan(per_part).any():
@@ -164,6 +164,24 @@ class PhyloInstance:
         """
         from examl_tpu.constants import ZMAX, ZMIN
 
+        if len(self.engines) == 1:
+            # Single state bucket (the common case): the entire operation —
+            # both partial traversals, the sumtable, and the NR loop to
+            # convergence — is ONE device dispatch (lax.while_loop), vs the
+            # reference's one Allreduce per NR iteration
+            # (`makenewzGenericSpecial.c:1241-1248`).
+            (eng,) = self.engines.values()
+            entries = (self._collect(tree, p, False)
+                       + self._collect(tree, q, False))
+            conv = self.partition_converged if mask_converged else None
+            z0v = np.asarray(z0, dtype=np.float64)
+            if len(z0v) != self.num_branch_slots:
+                z0v = np.full(self.num_branch_slots, z0v[0])
+            return eng.newton_branch(entries, p.number, q.number, z0v,
+                                     maxiter, conv)
+
+        # Mixed state buckets: derivatives must sum across engines each NR
+        # iteration, so the loop runs on host over per-engine sumtables.
         self.new_view(tree, p)
         self.new_view(tree, q)
         sts = {s: eng.make_sumtable(p.number, q.number)

@@ -52,6 +52,18 @@ def _next_pow2(n: int) -> int:
     return p
 
 
+def _bucket_len(n: int) -> int:
+    """Round a traversal length up to a bucketed size: multiples of 4 up to
+    16, then geometric buckets with <=25% padding (n rounded up to a
+    multiple of 2^(floor(log2 n) - 2)).  Keeps the number of compiled
+    traversal variants O(log n) while a padding wave costs a full W-wide
+    newview, so the waste per call stays bounded."""
+    if n <= 16:
+        return 4 * ((n + 3) // 4)
+    step = _next_pow2(n + 1) // 8
+    return step * ((n + step - 1) // step)
+
+
 class LikelihoodEngine:
     def __init__(self, bucket: PackedBucket, models: Sequence[ModelParams],
                  ntips: int, num_branch_slots: int = 1,
@@ -96,11 +108,17 @@ class LikelihoodEngine:
             self.apply_sharding(sharding)
 
         # One jitted traversal program; jax recompiles per padded entry-count
-        # shape (powers of two, so only a handful of variants exist).
+        # shape (powers of two, so only a handful of variants exist).  The
+        # CLV/scaler buffers are donated: they are replaced by the outputs,
+        # never read again.
         self._jit_traverse = jax.jit(
             lambda clv, scaler, tv, dm, block_part: kernels.traverse(
-                dm, block_part, clv, scaler, tv, self.scale_exp))
+                dm, block_part, clv, scaler, tv, self.scale_exp),
+            donate_argnums=(0, 1))
         self._jit_evaluate = jax.jit(self._evaluate_impl)
+        self._jit_trav_eval = jax.jit(self._trav_eval_impl,
+                                      donate_argnums=(0, 1))
+        self._jit_newton = jax.jit(self._newton_impl, donate_argnums=(0, 1))
         self._jit_sumtable = jax.jit(self._sumtable_impl)
         self._jit_derivs = jax.jit(self._derivs_impl)
 
@@ -143,16 +161,19 @@ class LikelihoodEngine:
         Waves wider than `wave_width` are chunked over several steps (their
         entries are independent, so any split is valid); narrow waves pad to
         W.  This keeps padding waste ~W/2 entries per wave while collapsing
-        the sequential step count from len(entries) to ~len(waves).  L and W
-        are powers of two so only a handful of compiled variants exist."""
+        the sequential step count from len(entries) to ~len(waves).  W is a
+        capped power of two and L is size-bucketed (_bucket_len) so only
+        O(log n) compiled variants exist."""
         from examl_tpu.tree.topology import Tree
         raw = Tree.schedule_waves(entries)
         cap = self.wave_width
         W = min(_next_pow2(max((len(w) for w in raw), default=1)), cap)
         waves = [w[i:i + W] for w in raw for i in range(0, len(w), W)]
-        # L pads to a multiple of 4 (not pow2): a padding wave costs a full
-        # W-wide newview, so pow2 rounding could nearly double step count.
-        L = max(4 * ((len(waves) + 3) // 4), 4)
+        # L rounds up into geometric buckets (<=25% padding waves, O(log n)
+        # compiled variants -- see _bucket_len).  An empty traversal stays
+        # empty (lax.scan over length 0) so fused traverse+evaluate/newton
+        # calls on already-oriented CLVs cost no newview.
+        L = _bucket_len(len(waves))
         C = self.num_branch_slots
         parent = np.full((L, W), self.scratch_row, dtype=np.int32)
         left = np.zeros((L, W), dtype=np.int32)
@@ -194,6 +215,56 @@ class LikelihoodEngine:
                                  zv, self.models, self.block_part,
                                  self.weights)
         return np.asarray(out)
+
+    # -- fused single-dispatch entry points ---------------------------------
+    # Traversal + root evaluation (resp. + sumtable + the whole NR loop) in
+    # ONE device program: the reference pays one reduction round-trip per
+    # evaluateGeneric and one per NR iteration (SURVEY §3.2-3.3); here each
+    # search step is a single dispatch.
+
+    def _trav_eval_impl(self, clv, scaler, tv, p_row, q_row, z, dm,
+                        block_part, weights):
+        clv, scaler = kernels.traverse(dm, block_part, clv, scaler, tv,
+                                       self.scale_exp)
+        lnl = kernels.root_log_likelihood(
+            dm, block_part, weights, clv, scaler, p_row, q_row, z,
+            self.num_parts, self.scale_exp)
+        return clv, scaler, lnl
+
+    def traverse_evaluate(self, entries: List[TraversalEntry], p_num: int,
+                          q_num: int, z: Sequence[float]) -> np.ndarray:
+        tv = self._traversal_arrays(entries)
+        zv = jnp.asarray(_z_slots(z, self.num_branch_slots), dtype=self.dtype)
+        self.clv, self.scaler, out = self._jit_trav_eval(
+            self.clv, self.scaler, tv, jnp.int32(p_num - 1),
+            jnp.int32(q_num - 1), zv, self.models, self.block_part,
+            self.weights)
+        return np.asarray(out)
+
+    def _newton_impl(self, clv, scaler, tv, p_row, q_row, z0, maxiters,
+                     conv, dm, block_part, weights):
+        clv, scaler = kernels.traverse(dm, block_part, clv, scaler, tv,
+                                       self.scale_exp)
+        st = kernels.sumtable(dm, block_part, clv[p_row], clv[q_row])
+        z = kernels.newton_raphson_branch(dm, block_part, weights, st, z0,
+                                          maxiters, conv,
+                                          self.num_branch_slots)
+        return clv, scaler, z
+
+    def newton_branch(self, entries: List[TraversalEntry], p_num: int,
+                      q_num: int, z0: np.ndarray, maxiter: int,
+                      conv_mask: Optional[np.ndarray] = None) -> np.ndarray:
+        """Fused traversal + sumtable + NR-to-convergence; returns new z [C]."""
+        tv = self._traversal_arrays(entries)
+        C = self.num_branch_slots
+        if conv_mask is None:
+            conv_mask = np.zeros(C, dtype=bool)
+        self.clv, self.scaler, z = self._jit_newton(
+            self.clv, self.scaler, tv, jnp.int32(p_num - 1),
+            jnp.int32(q_num - 1), jnp.asarray(z0),
+            jnp.full(C, maxiter, dtype=jnp.int32), jnp.asarray(conv_mask),
+            self.models, self.block_part, self.weights)
+        return np.asarray(z, dtype=np.float64)
 
     # -- branch derivatives ------------------------------------------------
 

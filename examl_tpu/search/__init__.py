@@ -1,0 +1,1 @@
+"""Tree-search layer: SPR hill climbing, tree snapshots, search driver."""

@@ -1,0 +1,120 @@
+"""SPR search: primitives keep the tree consistent; the full hill climb
+improves lnL; snapshots restore exactly."""
+
+import numpy as np
+import pytest
+
+from examl_tpu.instance import PhyloInstance
+from examl_tpu.io.alignment import build_alignment_data, load_alignment
+from examl_tpu.optimize.branch import tree_evaluate
+from examl_tpu.search.raxml_search import (SearchOptions, compute_big_rapid,
+                                           tree_optimize_rapid)
+from examl_tpu.search.snapshots import BestList, InfoList, TreeSnapshot
+from examl_tpu.search.spr import SprContext, dfs_slot_order, rearrange
+
+from tests.conftest import TESTDATA
+
+
+def _correlated_dna(ntaxa, nsites, seed=42, mut=0.15):
+    rng = np.random.default_rng(seed)
+    cur = rng.integers(0, 4, nsites)
+    seqs = []
+    for _ in range(ntaxa):
+        flip = rng.random(nsites) < mut
+        cur = np.where(flip, rng.integers(0, 4, nsites), cur)
+        seqs.append("".join("ACGT"[c] for c in cur))
+    return build_alignment_data([f"t{i}" for i in range(ntaxa)], seqs)
+
+
+@pytest.fixture(scope="module")
+def inst12():
+    return PhyloInstance(_correlated_dna(12, 300))
+
+
+def test_snapshot_roundtrip_exact(inst12):
+    tree = inst12.random_tree(seed=3)
+    lnl = tree_evaluate(inst12, tree, 1.0)
+    snap = TreeSnapshot.capture(tree, lnl)
+    other = TreeSnapshot.capture(inst12.random_tree(seed=9), 0.0)
+    other.restore_into(tree)
+    assert inst12.evaluate(tree, full=True) != pytest.approx(lnl)
+    snap.restore_into(tree)
+    assert inst12.evaluate(tree, full=True) == pytest.approx(lnl, abs=1e-9)
+
+
+def test_bestlist_dedup_and_ranking(inst12):
+    bl = BestList(3)
+    t1 = inst12.random_tree(seed=1)
+    assert bl.save(t1, -100.0) == 1
+    assert bl.save(t1, -200.0) == 0          # same topology, worse: rejected
+    assert bl.save(t1, -50.0) == 1           # same topology, better: refresh
+    t2 = inst12.random_tree(seed=2)
+    assert bl.save(t2, -75.0) == 2
+    assert bl.nvalid == 2
+    assert bl.entries[0].likelihood == -50.0
+
+
+def test_infolist_replaces_min():
+    il = InfoList(3)
+    il.insert("a", -10.0)
+    il.insert("b", -5.0)
+    il.insert("c", -20.0)
+    il.insert("d", -1.0)                      # replaces c (-20)
+    assert set(il.nodes) == {"a", "b", "d"}
+
+
+def test_rearrange_restores_tree_state(inst12):
+    """rearrange() must leave topology+branches exactly as it found them
+    when no improving move is committed."""
+    tree = inst12.random_tree(seed=5)
+    tree_evaluate(inst12, tree, 1.0)
+    before = TreeSnapshot.capture(tree, inst12.likelihood)
+    ctx = SprContext(inst12, do_cutoff=False)
+    ctx.start_lh = ctx.end_lh = np.inf       # nothing beats +inf: no commit
+    p = dfs_slot_order(tree)[tree.ntips + 2]
+    rearrange(inst12, tree, ctx, p, 1, 5)
+    after = TreeSnapshot.capture(tree, inst12.likelihood)
+    assert before.key == after.key
+    za = {tuple(sorted((u, v))): z for u, v, z in before.edges}
+    zb = {tuple(sorted((u, v))): z for u, v, z in after.edges}
+    assert za.keys() == zb.keys()
+    for k in za:
+        assert za[k] == pytest.approx(zb[k], abs=1e-12)
+
+
+def test_spr_cycle_improves_random_tree(inst12):
+    tree = inst12.random_tree(seed=7)
+    lnl0 = tree_evaluate(inst12, tree, 1.0)
+    ctx = SprContext(inst12, do_cutoff=True)
+    bt = BestList(20)
+    tree_optimize_rapid(inst12, tree, ctx, 1, 5, bt, None, InfoList(50))
+    assert bt.nvalid >= 1
+    assert bt.best_lnl > lnl0
+
+
+@pytest.mark.slow
+def test_full_search_small():
+    inst = PhyloInstance(_correlated_dna(12, 300))
+    tree = inst.random_tree(seed=7)
+    lnl0 = inst.evaluate(tree, full=True)
+    res = compute_big_rapid(inst, tree, SearchOptions())
+    assert res.likelihood > lnl0 + 10
+    assert res.fast_iterations >= 1
+    assert res.thorough_iterations >= 1
+    # The final tree in `tree` evaluates to the reported likelihood.
+    assert inst.evaluate(tree, full=True) == pytest.approx(res.likelihood)
+
+
+@pytest.mark.slow
+def test_search_49_improves_parsimonyless_start():
+    """End-to-end on the reference 49-taxon DNA fixture: search from the
+    shipped starting tree must improve lnL substantially and end stable."""
+    data = load_alignment(f"{TESTDATA}/49", f"{TESTDATA}/49.model")
+    inst = PhyloInstance(data)
+    with open(f"{TESTDATA}/49.tree") as f:
+        tree = inst.tree_from_newick(f.read())
+    lnl0 = inst.evaluate(tree, full=True)
+    opts = SearchOptions(initial_set=True, initial=5)
+    res = compute_big_rapid(inst, tree, opts)
+    assert res.likelihood > lnl0
+    assert inst.evaluate(tree, full=True) == pytest.approx(res.likelihood)
