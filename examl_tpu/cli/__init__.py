@@ -1,0 +1,1 @@
+"""Command-line entry points: the offline parser and the inference driver."""

@@ -1,0 +1,269 @@
+"""Inference driver CLI — the counterpart of the reference `examl` binary.
+
+Flag surface and output files mirror the reference driver (`examl/axml.c`:
+`get_args` :935-1302, `printREADME` :777-900, `makeFileNames` :1316-1357;
+modes dispatched at `main` :2719-2781):
+
+  -s byteFile  -n runId  -t startTree | -R (restart from checkpoint)
+  -m GAMMA|PSR  -a (median gamma)  -c #categories (PSR)
+  -f d|o|e|E|q  -e lnL-epsilon  -i radius  -D (RF convergence)
+  -B #best trees  -M (per-partition branches)  -S (memory saving)
+  -w workdir  --auto-prot=ml|bic|aic|aicc
+
+Outputs in workdir: ExaML_info.RUNID (config + progress),
+ExaML_log.RUNID ("seconds lnL" rows), ExaML_result.RUNID (newick),
+ExaML_modelFile.RUNID (final model parameters),
+ExaML_TreeFile.RUNID (-f e/E per-tree results).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+
+def build_argparser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(
+        prog="examl-tpu", description="TPU-native maximum-likelihood "
+        "phylogenetic tree inference")
+    ap.add_argument("-s", dest="bytefile", required=True,
+                    help="binary alignment file from the parser "
+                         "(PHYLIP also accepted)")
+    ap.add_argument("-n", dest="run_id", required=True, help="run name")
+    ap.add_argument("-t", dest="tree_file", default=None,
+                    help="starting tree (newick)")
+    ap.add_argument("-R", dest="restart", action="store_true",
+                    help="restart from the newest checkpoint")
+    ap.add_argument("-m", dest="model", default="GAMMA",
+                    choices=["GAMMA", "PSR"], help="rate heterogeneity model")
+    ap.add_argument("-a", dest="median", action="store_true",
+                    help="median instead of mean discrete gamma rates")
+    ap.add_argument("-c", dest="categories", type=int, default=25,
+                    help="maximum PSR rate categories")
+    ap.add_argument("-f", dest="mode", default="d",
+                    choices=["d", "o", "e", "E", "q"], help="algorithm: "
+                    "d/o tree search (o disables the lnL cutoff), "
+                    "e/E evaluate trees (E re-optimizes the model per "
+                    "tree), q quartets")
+    ap.add_argument("-e", dest="epsilon", type=float, default=0.1,
+                    help="lnL epsilon for quartet-mode model optimization "
+                         "(the search and tree-evaluation modes use the "
+                         "reference's fixed modOpt schedule)")
+    ap.add_argument("-i", dest="initial", type=int, default=None,
+                    help="fixed initial rearrangement radius")
+    ap.add_argument("-D", dest="rf_convergence", action="store_true",
+                    help="stop when consecutive SPR cycles are <=1%% RF "
+                         "apart")
+    ap.add_argument("-B", dest="save_best", type=int, default=0,
+                    help="also report the N best distinct trees found")
+    ap.add_argument("-M", dest="per_partition_bl", action="store_true",
+                    help="estimate per-partition branch lengths")
+    ap.add_argument("-S", dest="save_memory", action="store_true",
+                    help="memory saving for gappy alignments")
+    ap.add_argument("-w", dest="workdir", default=".",
+                    help="output directory")
+    ap.add_argument("-g", dest="constraint_file", default=None,
+                    help="multifurcating constraint tree")
+    ap.add_argument("-p", dest="seed", type=int, default=12345,
+                    help="random seed (constraint-tree resolution)")
+    ap.add_argument("-Q", dest="quartet_file", default=None,
+                    help="quartet grouping file (-f q)")
+    ap.add_argument("-r", dest="quartet_samples", type=int, default=0,
+                    help="number of random quartets to evaluate (-f q)")
+    ap.add_argument("-I", dest="quartet_ckpt_interval", type=int,
+                    default=10000,
+                    help="quartet checkpoint interval (-f q)")
+    ap.add_argument("--auto-prot", dest="auto_prot", default="ml",
+                    choices=["ml", "bic", "aic", "aicc"],
+                    help="criterion for AUTO protein model selection")
+    return ap
+
+
+class RunFiles:
+    """Rank-0 output files (reference `makeFileNames`/`printBothOpen`).
+
+    On a -R restart, existing info/log files are appended to, preserving
+    the interrupted run's history (the reference appends likewise)."""
+
+    def __init__(self, workdir: str, run_id: str, append: bool = False):
+        os.makedirs(workdir, exist_ok=True)
+        pre = os.path.join(workdir, "ExaML_")
+        self.info_path = f"{pre}info.{run_id}"
+        self.log_path = f"{pre}log.{run_id}"
+        self.result_path = f"{pre}result.{run_id}"
+        self.model_path = f"{pre}modelFile.{run_id}"
+        self.treefile_path = f"{pre}TreeFile.{run_id}"
+        self.start_time = time.time()
+        if not append:
+            for p in (self.info_path, self.log_path):
+                open(p, "w").close()
+
+    def info(self, msg: str) -> None:
+        print(msg)
+        with open(self.info_path, "a") as f:
+            f.write(msg + "\n")
+
+    def log_lnl(self, lnl: float) -> None:
+        with open(self.log_path, "a") as f:
+            f.write(f"{time.time() - self.start_time:.6f} {lnl:.6f}\n")
+
+    def write_result(self, text: str) -> None:
+        with open(self.result_path, "w") as f:
+            f.write(text if text.endswith("\n") else text + "\n")
+
+
+def write_model_params(path: str, inst) -> None:
+    """Final model parameters (reference `printModelParams`,
+    `axml.c:1733-1835`)."""
+    with open(path, "w") as f:
+        for gid, (part, m) in enumerate(
+                zip(inst.alignment.partitions, inst.models)):
+            name = inst.auto_prot_models.get(gid, part.model_name)
+            f.write(f"Partition: {gid} {part.name}\n")
+            f.write(f"DataType: {part.datatype.name}\n")
+            f.write(f"Substitution model: {name}\n")
+            f.write(f"alpha: {m.alpha:.6f}\n")
+            f.write("rates: " + " ".join(f"{r:.6f}" for r in m.rates) + "\n")
+            f.write("freqs: " + " ".join(f"{x:.6f}" for x in m.freqs) + "\n")
+            f.write("\n")
+
+
+def _load_alignment(path: str):
+    from examl_tpu.io.bytefile import BYTEFILE_MAGIC, read_bytefile
+    import struct
+    with open(path, "rb") as f:
+        head = f.read(12)
+    if len(head) == 12 and struct.unpack("<iii", head)[2] == BYTEFILE_MAGIC:
+        return read_bytefile(path)
+    from examl_tpu.io.alignment import load_alignment
+    return load_alignment(path)             # convenience: raw PHYLIP, DNA
+
+
+def _read_trees(path: str):
+    with open(path) as f:
+        text = f.read()
+    return [t.strip() + ";" for t in text.split(";") if t.strip()]
+
+
+def run_search(args, inst, files: RunFiles) -> int:
+    from examl_tpu.search.checkpoint import CheckpointManager
+    from examl_tpu.search.convergence import RfConvergence
+    from examl_tpu.search.raxml_search import (SearchOptions,
+                                               compute_big_rapid)
+
+    mgr = CheckpointManager(args.workdir, args.run_id)
+    resume = None
+    if args.restart:
+        tree = inst.random_tree(seed=args.seed)     # overwritten by restore
+        resume = mgr.restore(inst, tree)
+        if resume is None:
+            files.info("no checkpoint found; cannot restart")
+            return 1
+        files.info(f"restart from state {resume['state']} with likelihood "
+                   f"{inst.likelihood:.6f}")
+    else:
+        if not args.tree_file:
+            files.info("a starting tree (-t) or -R is required for the "
+                       "tree search")
+            return 1
+        tree = inst.tree_from_newick(_read_trees(args.tree_file)[0])
+        inst.evaluate(tree, full=True)
+        files.info(f"starting tree lnL {inst.likelihood:.6f}")
+    files.log_lnl(inst.likelihood)
+
+    def log(msg: str) -> None:
+        files.info(msg)
+        files.log_lnl(inst.likelihood)
+
+    opts = SearchOptions(
+        initial=args.initial if args.initial is not None else 10,
+        initial_set=args.initial is not None,
+        save_best_trees=args.save_best,
+        do_cutoff=args.mode != "o",
+        search_convergence=args.rf_convergence,
+        likelihood_epsilon=args.epsilon,
+        log=log)
+    conv = (RfConvergence(inst.alignment.ntaxa, log=files.info)
+            if args.rf_convergence else None)
+    res = compute_big_rapid(inst, tree, opts, convergence_cb=conv,
+                            checkpoint_cb=mgr.callback(inst, tree),
+                            resume=resume)
+
+    files.info(f"Likelihood of best tree: {res.likelihood:.6f}")
+    files.write_result(tree.to_newick(inst.alignment.taxon_names))
+    write_model_params(files.model_path, inst)
+    if res.good_trees:
+        good = os.path.join(args.workdir,
+                            f"ExaML_goodTrees.{args.run_id}")
+        with open(good, "w") as f:
+            for snap in res.good_trees:
+                snap.restore_into(tree)
+                f.write(tree.to_newick(inst.alignment.taxon_names) + "\n")
+        files.info(f"{len(res.good_trees)} other good trees written to "
+                   f"{good}")
+    return 0
+
+
+def run_tree_evaluation(args, inst, files: RunFiles) -> int:
+    """-f e / -f E: optimize model+branches on each tree in the file
+    (reference `optimizeTrees`, `axml.c:2251-2356`)."""
+    from examl_tpu.optimize.branch import tree_evaluate
+    from examl_tpu.optimize.model_opt import mod_opt
+
+    if not args.tree_file:
+        files.info("tree evaluation mode requires -t")
+        return 1
+    trees_txt = _read_trees(args.tree_file)
+    files.info(f"Found {len(trees_txt)} trees to evaluate")
+    fast = args.mode == "e"
+    results = []
+    for i, txt in enumerate(trees_txt):
+        tree = inst.tree_from_newick(txt)
+        inst.evaluate(tree, full=True)
+        if fast and i > 0:
+            tree_evaluate(inst, tree, 2.0)
+        else:
+            tree_evaluate(inst, tree, 1.0)
+            mod_opt(inst, tree, 0.1)
+        files.info(f"Likelihood tree {i}: {inst.likelihood:.6f}")
+        files.log_lnl(inst.likelihood)
+        results.append(tree.to_newick(inst.alignment.taxon_names))
+    with open(files.treefile_path, "w") as f:
+        f.write("\n".join(results) + "\n")
+    write_model_params(files.model_path, inst)
+    return 0
+
+
+def main(argv=None) -> int:
+    args = build_argparser().parse_args(argv)
+    files = RunFiles(args.workdir, args.run_id, append=args.restart)
+    files.info("examl-tpu: TPU-native maximum likelihood inference "
+               "(capability parity with ExaML 3.0.22)")
+    files.info(f"alignment: {args.bytefile}  mode: -f {args.mode}  "
+               f"model: {args.model}")
+
+    from examl_tpu.instance import PhyloInstance
+    data = _load_alignment(args.bytefile)
+    files.info(f"{data.ntaxa} taxa, {data.total_patterns} patterns, "
+               f"{len(data.partitions)} partitions")
+
+    inst = PhyloInstance(
+        data, ncat=4, use_median=args.median,
+        per_partition_branches=args.per_partition_bl,
+        rate_model=args.model, psr_categories=args.categories,
+        save_memory=args.save_memory)
+
+    if args.mode in ("d", "o"):
+        return run_search(args, inst, files)
+    if args.mode in ("e", "E"):
+        return run_tree_evaluation(args, inst, files)
+    if args.mode == "q":
+        from examl_tpu.cli.quartets import run_quartets
+        return run_quartets(args, inst, files)
+    raise AssertionError(args.mode)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

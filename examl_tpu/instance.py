@@ -26,8 +26,19 @@ class PhyloInstance:
     def __init__(self, alignment: AlignmentData, dtype=None,
                  ncat: int = 4, use_median: bool = False,
                  per_partition_branches: bool = False,
-                 block_multiple: int = 1, sharding=None):
+                 block_multiple: int = 1, sharding=None,
+                 rate_model: str = "GAMMA", psr_categories: int = 25,
+                 save_memory: bool = False):
         from examl_tpu.config import default_dtype
+        if rate_model not in ("GAMMA", "PSR"):
+            raise ValueError(f"unknown rate model {rate_model!r}")
+        if rate_model == "PSR":
+            raise NotImplementedError(
+                "the PSR per-site-rate model is not available yet; "
+                "use -m GAMMA")
+        self.rate_model = rate_model
+        self.psr_categories = psr_categories
+        self.save_memory = save_memory       # SEV mode: planned, accepted now
         self.alignment = alignment
         self.dtype = jnp.dtype(dtype if dtype is not None else default_dtype())
         self.ncat = ncat
@@ -170,14 +181,13 @@ class PhyloInstance:
             # convergence — is ONE device dispatch (lax.while_loop), vs the
             # reference's one Allreduce per NR iteration
             # (`makenewzGenericSpecial.c:1241-1248`).
+            from examl_tpu.utils import z_slots
             (eng,) = self.engines.values()
             entries = (self._collect(tree, p, False)
                        + self._collect(tree, q, False))
             conv = self.partition_converged if mask_converged else None
-            z0v = np.asarray(z0, dtype=np.float64)
-            if len(z0v) != self.num_branch_slots:
-                z0v = np.full(self.num_branch_slots, z0v[0])
-            return eng.newton_branch(entries, p.number, q.number, z0v,
+            return eng.newton_branch(entries, p.number, q.number,
+                                     z_slots(z0, self.num_branch_slots),
                                      maxiter, conv)
 
         # Mixed state buckets: derivatives must sum across engines each NR

@@ -1,0 +1,169 @@
+"""Binary "byteFile" alignment format, compatible with the reference parser.
+
+Layout (reference writer `parser/axml.c:2752-2887`, reader
+`examl/byteFile.c:31-433`):
+
+  int32  sizeof(size_t) on the writing system (must be 8)
+  int32  version            (3022)
+  int32  magic              (6517718)
+  int32  numTax
+  uint64 numPattern          (global, over all partitions)
+  int32  numPartitions
+  f64    gappyness
+  int32[numPattern]          pattern weights
+  per taxon:      int32 len; char[len] name (NUL-terminated)
+  per partition:  int32 states; int32 maxTipStates; uint64 lower;
+                  uint64 upper; uint64 width; int32 dataType;
+                  int32 protModels; int32 protFreqs; int32 nonGTR;
+                  int32 optimizeBaseFrequencies;
+                  int32 len; char[len] name; f64[states] frequencies
+  alignment:      per partition, per taxon: uint8[upper-lower] codes
+                  (partition-major, taxon-major within partition)
+
+State codes are the reference's meaning-table values, which
+examl_tpu.datatypes reproduces (DNA: IUPAC bitmask 1-15; AA: 0-19 + B=20,
+Z=21, X/-=22; BIN: 1, 2, 3).
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import List, Sequence
+
+import numpy as np
+
+from examl_tpu import datatypes
+from examl_tpu.io.alignment import (AlignmentData, PartitionData,
+                                    empirical_frequencies)
+
+BYTEFILE_VERSION = 3022
+BYTEFILE_MAGIC = 6517718
+
+# Reference enum values (examl/axml.h:240-264, 307-314).
+DATATYPE_INT = {"BIN": 0, "DNA": 1, "AA": 2}
+DATATYPE_NAME = {v: k for k, v in DATATYPE_INT.items()}
+PROT_MODELS = ["DAYHOFF", "DCMUT", "JTT", "MTREV", "WAG", "RTREV", "CPREV",
+               "VT", "BLOSUM62", "MTMAM", "LG", "MTART", "MTZOA", "PMB",
+               "HIVB", "HIVW", "JTTDCMUT", "FLU", "STMTREV", "AUTO",
+               "LG4M", "LG4X", "GTR"]
+PROT_INDEX = {m: i for i, m in enumerate(PROT_MODELS)}
+JTT = PROT_INDEX["JTT"]
+
+
+def _w(f, fmt: str, *vals) -> None:
+    f.write(struct.pack("<" + fmt, *vals))
+
+
+def _r(f, fmt: str):
+    size = struct.calcsize("<" + fmt)
+    data = f.read(size)
+    if len(data) != size:
+        raise ValueError("truncated byteFile")
+    return struct.unpack("<" + fmt, data)
+
+
+def _write_string(f, s: str) -> None:
+    b = s.encode("utf-8") + b"\0"
+    _w(f, "i", len(b))
+    f.write(b)
+
+
+def _read_string(f) -> str:
+    (n,) = _r(f, "i")
+    return f.read(n).rstrip(b"\0").decode("utf-8")
+
+
+def gappyness(parts: Sequence[PartitionData]) -> float:
+    """Share of fully-undetermined characters, weighted by pattern counts."""
+    undet = total = 0
+    for p in parts:
+        w = p.weights[None, :]
+        undet += int(((p.patterns == p.datatype.undetermined_code) * w).sum())
+        total += int(p.patterns.shape[0] * p.weights.sum())
+    return undet / total if total else 0.0
+
+
+def write_bytefile(path: str, data: AlignmentData) -> None:
+    """Write an AlignmentData (already pattern-compressed) as a byteFile."""
+    parts = data.partitions
+    num_pattern = sum(p.width for p in parts)
+    with open(path, "wb") as f:
+        _w(f, "iii", 8, BYTEFILE_VERSION, BYTEFILE_MAGIC)
+        _w(f, "i", data.ntaxa)
+        _w(f, "Q", num_pattern)
+        _w(f, "i", len(parts))
+        _w(f, "d", gappyness(parts))
+        weights = np.concatenate([p.weights for p in parts]).astype("<i4")
+        f.write(weights.tobytes())
+        for name in data.taxon_names:
+            _write_string(f, name)
+        lower = 0
+        for p in parts:
+            upper = lower + p.width
+            if p.datatype.name == "AA":
+                prot = PROT_INDEX.get("AUTO" if p.auto else p.model_name, JTT)
+            else:
+                prot = JTT                   # ignored for non-AA (ref default)
+            _w(f, "ii", p.states, p.datatype.num_codes)
+            _w(f, "QQQ", lower, upper, upper - lower)
+            _w(f, "iiiii", DATATYPE_INT[p.datatype.name], prot,
+               int(p.use_empirical_freqs), 0, int(p.optimize_freqs))
+            _write_string(f, p.name)
+            f.write(np.asarray(p.empirical_freqs, dtype="<f8").tobytes())
+            lower = upper
+        for p in parts:
+            f.write(np.ascontiguousarray(p.patterns, dtype=np.uint8).tobytes())
+
+
+def read_bytefile(path: str) -> AlignmentData:
+    """Read a byteFile (ours or the reference parser's) into AlignmentData."""
+    with open(path, "rb") as f:
+        szt, version, magic = _r(f, "iii")
+        if magic != BYTEFILE_MAGIC:
+            raise ValueError(f"{path}: not a byteFile (magic {magic})")
+        if szt != 8:
+            raise ValueError(f"{path}: written on a {8 * szt}-bit system")
+        if version != BYTEFILE_VERSION:
+            raise ValueError(f"{path}: byteFile version {version}, "
+                             f"expected {BYTEFILE_VERSION}")
+        (ntaxa,) = _r(f, "i")
+        (num_pattern,) = _r(f, "Q")
+        (num_parts,) = _r(f, "i")
+        _r(f, "d")                                    # gappyness (stats only)
+        weights = np.frombuffer(f.read(4 * num_pattern), dtype="<i4")
+        names = [_read_string(f) for _ in range(ntaxa)]
+        metas = []
+        for _ in range(num_parts):
+            states, _max_tip = _r(f, "ii")
+            lower, upper, _width = _r(f, "QQQ")
+            dtype_i, prot, prot_freqs, _non_gtr, opt_freqs = _r(f, "iiiii")
+            pname = _read_string(f)
+            freqs = np.frombuffer(f.read(8 * states), dtype="<f8")
+            metas.append((states, lower, upper, dtype_i, prot,
+                          bool(prot_freqs), bool(opt_freqs), pname, freqs))
+        parts: List[PartitionData] = []
+        for (states, lower, upper, dtype_i, prot, prot_freqs, opt_freqs,
+             pname, freqs) in metas:
+            dt = datatypes.get(DATATYPE_NAME[dtype_i])
+            width = upper - lower
+            raw = np.frombuffer(f.read(ntaxa * width), dtype=np.uint8)
+            patterns = raw.reshape(ntaxa, width)
+            w = weights[lower:upper].astype(np.int64)
+            if dt.name == "AA":
+                model_name = PROT_MODELS[prot]
+            elif dt.name == "DNA":
+                model_name = "DNA"
+            else:
+                model_name = "BIN"
+            auto = model_name == "AUTO"
+            lg4 = model_name in ("LG4M", "LG4X")
+            emp = np.asarray(freqs, dtype=np.float64)
+            if not np.isfinite(emp).all() or emp.sum() <= 0:
+                emp = empirical_frequencies(patterns, w, dt)
+            parts.append(PartitionData(
+                name=pname, datatype=dt, model_name=model_name,
+                patterns=np.ascontiguousarray(patterns), weights=w,
+                empirical_freqs=emp,
+                use_empirical_freqs=prot_freqs or dt.name != "AA",
+                optimize_freqs=opt_freqs, lg4=lg4, auto=auto))
+    return AlignmentData(names, parts)

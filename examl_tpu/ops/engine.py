@@ -287,12 +287,4 @@ class LikelihoodEngine:
         return np.asarray(d1), np.asarray(d2)
 
 
-def _z_slots(z: Sequence[float] | float, num_slots: int) -> np.ndarray:
-    z = np.atleast_1d(np.asarray(z, dtype=np.float64))
-    if len(z) == num_slots:
-        return z
-    if len(z) == 1:
-        return np.full(num_slots, z[0])
-    if len(z) > num_slots:
-        return z[:num_slots]
-    raise ValueError(f"branch vector length {len(z)} vs slots {num_slots}")
+from examl_tpu.utils import z_slots as _z_slots  # noqa: E402

@@ -18,10 +18,9 @@ from examl_tpu.tree.topology import Node, Tree
 
 def update_branch(inst: PhyloInstance, tree: Tree, p: Node) -> None:
     """One-branch NR update + smoothed-flag bookkeeping (ref `update`)."""
+    from examl_tpu.utils import z_slots
     q = p.back
-    z0 = np.asarray(q.z, dtype=np.float64)
-    if len(z0) != inst.num_branch_slots:
-        z0 = np.full(inst.num_branch_slots, z0[0])
+    z0 = z_slots(q.z, inst.num_branch_slots)
     z = inst.makenewz(tree, p, q, z0, maxiter=1,
                       mask_converged=inst.num_branch_slots > 1)
     moved = np.abs(z - z0) > DELTAZ

@@ -1,0 +1,171 @@
+"""Checkpoint / restart subsystem.
+
+Reference semantics (ExaML `searchAlgo.c:1102-1750`, SURVEY §5.4):
+checkpoints cover every long-running phase (REARR_SETTING / FAST_SPRS /
+SLOW_SPRS / MOD_OPT, later QUARTETS), files are monotonically numbered and
+never overwritten, and a restart refuses mismatched command-line flags
+(`checkCommandLineArguments` :1383-1500).  Unlike the reference's raw
+`node`-array dump with pointer rebasing (:1335-1370) — a design SURVEY
+flags as non-portable — state is serialized as gzipped JSON: edge-list
+tree snapshots, raw model parameters (rates/freqs/alpha; eigensystems are
+recomputed), search counters, and the best-tree list.
+"""
+
+from __future__ import annotations
+
+import glob
+import gzip
+import json
+import os
+import re
+from typing import Optional
+
+import numpy as np
+
+from examl_tpu.instance import PhyloInstance
+from examl_tpu.models import protein as protein_mod
+from examl_tpu.models.gtr import build_model
+from examl_tpu.search.snapshots import TreeSnapshot
+from examl_tpu.tree.topology import Tree
+
+CKPT_VERSION = 1
+CKPT_MAGIC = "examl-tpu-checkpoint"
+
+
+def _fingerprint(inst: PhyloInstance) -> dict:
+    """Alignment/flag identity that must match between run and restart."""
+    al = inst.alignment
+    return {
+        "ntaxa": al.ntaxa,
+        "partitions": [[p.name, p.states, int(np.sum(p.weights))]
+                       for p in al.partitions],
+        "ncat": inst.ncat,
+        "use_median": inst.use_median,
+        "per_partition_branches": inst.per_partition_branches,
+    }
+
+
+def _models_blob(inst: PhyloInstance) -> list:
+    out = []
+    for gid, m in enumerate(inst.models):
+        out.append({
+            "rates": np.asarray(m.rates).tolist(),
+            "freqs": np.asarray(m.freqs).tolist(),
+            "alpha": float(m.alpha),
+            "auto_name": inst.auto_prot_models.get(gid),
+        })
+    return out
+
+
+def _restore_models(inst: PhyloInstance, blob: list) -> None:
+    for gid, d in enumerate(blob):
+        part = inst.alignment.partitions[gid]
+        if d.get("auto_name"):
+            inst.auto_prot_models[gid] = d["auto_name"]
+        inst.models[gid] = build_model(
+            part.datatype, np.asarray(d["freqs"]),
+            rates=np.asarray(d["rates"]), alpha=d["alpha"],
+            ncat=inst.ncat, use_median=inst.use_median)
+    inst.push_models()
+
+
+class CheckpointManager:
+    """Writes numbered checkpoint files and restores the newest one.
+
+    Usage: mgr = CheckpointManager(workdir, run_id);
+    compute_big_rapid(..., checkpoint_cb=mgr.callback(inst, tree)),
+    and on restart resume = mgr.restore(inst, tree).
+    """
+
+    FILE_RE = re.compile(r"\.ckpt_(\d+)\.json\.gz$")
+
+    def __init__(self, workdir: str, run_id: str):
+        self.workdir = workdir
+        self.run_id = run_id
+        os.makedirs(workdir, exist_ok=True)
+        self.counter = self._max_existing() + 1
+
+    def _pattern(self) -> str:
+        return os.path.join(self.workdir,
+                            f"ExaML_binaryCheckpoint.{self.run_id}"
+                            ".ckpt_*.json.gz")
+
+    def _max_existing(self) -> int:
+        nums = [int(m.group(1)) for f in glob.glob(self._pattern())
+                if (m := self.FILE_RE.search(f))]
+        return max(nums, default=-1)
+
+    def path_for(self, n: int) -> str:
+        return os.path.join(self.workdir,
+                            f"ExaML_binaryCheckpoint.{self.run_id}"
+                            f".ckpt_{n}.json.gz")
+
+    # -- write --------------------------------------------------------------
+
+    def write(self, state: str, extras: dict, inst: PhyloInstance,
+              tree: Tree, tree_dict: Optional[dict] = None) -> str:
+        """tree_dict overrides the captured tree — used by quartet mode,
+        where the live tree is a scaffold with asymmetric hookups that an
+        edge-list snapshot cannot represent (the comprehensive model tree
+        is checkpointed instead)."""
+        if tree_dict is None:
+            tree_dict = TreeSnapshot.capture(
+                tree, getattr(inst, "likelihood", 0.0),
+                with_key=False).to_dict()
+        blob = {
+            "magic": CKPT_MAGIC,
+            "version": CKPT_VERSION,
+            "state": state,
+            "counter": self.counter,
+            "fingerprint": _fingerprint(inst),
+            "models": _models_blob(inst),
+            "tree": tree_dict,
+            "extras": extras,
+        }
+        path = self.path_for(self.counter)
+        tmp = path + ".tmp"
+        with gzip.open(tmp, "wt") as f:
+            json.dump(blob, f)
+        os.replace(tmp, path)       # atomic publish; never overwrite older
+        self.counter += 1
+        return path
+
+    def callback(self, inst: PhyloInstance, tree: Tree):
+        def cb(state: str, extras: dict) -> None:
+            self.write(state, extras, inst, tree)
+        return cb
+
+    # -- restore ------------------------------------------------------------
+
+    def latest_path(self) -> Optional[str]:
+        n = self._max_existing()
+        return self.path_for(n) if n >= 0 else None
+
+    def restore(self, inst: PhyloInstance, tree: Tree,
+                path: Optional[str] = None) -> Optional[dict]:
+        """Load the newest (or given) checkpoint into inst+tree; returns the
+        resume blob for compute_big_rapid, or None if no checkpoint exists.
+
+        Raises ValueError on an incompatible run configuration (the
+        reference aborts on mismatched restart flags)."""
+        path = path or self.latest_path()
+        if path is None:
+            return None
+        with gzip.open(path, "rt") as f:
+            blob = json.load(f)
+        if blob.get("magic") != CKPT_MAGIC:
+            raise ValueError(f"not an examl-tpu checkpoint: {path}")
+        if blob.get("version") != CKPT_VERSION:
+            raise ValueError(f"checkpoint version {blob.get('version')} "
+                             f"unsupported")
+        fp_now = _fingerprint(inst)
+        fp_ckpt = blob["fingerprint"]
+        if fp_now != fp_ckpt:
+            raise ValueError(
+                "checkpoint was written for a different run configuration "
+                f"(checkpoint {fp_ckpt} vs current {fp_now}); restart must "
+                "use the same alignment, partitions, and model flags")
+        _restore_models(inst, blob["models"])
+        TreeSnapshot.from_dict(blob["tree"]).restore_into(tree)
+        inst.evaluate(tree, full=True)
+        return {"state": blob["state"], "extras": blob["extras"]}

@@ -37,7 +37,8 @@ class SearchOptions:
     do_cutoff: bool = True             # lnL cutoff heuristic (no -f o flag)
     big_cutoff: bool = False
     search_convergence: bool = False   # -D RF criterion
-    likelihood_epsilon: float = 0.1    # -e
+    # Note: the reference's -e likelihoodEpsilon does NOT enter the search;
+    # its modOpt schedule is fixed at 10/5/1 (searchAlgo.c:1996,2038,2327).
     log: Callable[[str], None] = field(default=lambda msg: None)
 
 
@@ -184,6 +185,7 @@ def compute_big_rapid(inst: PhyloInstance, tree: Tree,
     difference = 10.0
     epsilon = 0.01
     lh = previous_lh = UNLIKELY
+    best_trav = opts.initial
     fast_iterations = 0
     thorough_iterations = 0
     rearr_min = rearr_max = 0
@@ -192,8 +194,7 @@ def compute_big_rapid(inst: PhyloInstance, tree: Tree,
     def ckpt(name: str, extras: dict) -> None:
         if checkpoint_cb is None:
             return
-        extras = dict(extras)
-        extras.update(
+        merged = dict(
             best_trav=best_trav, lh=lh, previous_lh=previous_lh,
             difference=difference, epsilon=epsilon,
             fast_iterations=fast_iterations,
@@ -202,7 +203,8 @@ def compute_big_rapid(inst: PhyloInstance, tree: Tree,
             it_count=ctx.it_count, lh_cutoff=ctx.lh_cutoff,
             lh_avg=ctx.lh_avg, lh_dec=ctx.lh_dec,
             likelihood=inst.likelihood, best_t=best_t.to_dict())
-        checkpoint_cb(name, extras)
+        merged.update(extras)        # phase-specific values win
+        checkpoint_cb(name, merged)
 
     if resume and state == "REARR_SETTING":
         # Radius determination is cheap relative to the SPR phases: restore
@@ -210,11 +212,16 @@ def compute_big_rapid(inst: PhyloInstance, tree: Tree,
         # (the reference re-enters mid-scan; the search outcome only
         # depends on the returned radius).
         blob = resume["extras"]
-        if "best_t" in blob:
+        if "best_t" in blob and blob["best_t"]["entries"]:
             best_t.load_dict(blob["best_t"], tree)
             best_t.recall(inst, tree, 1)
+        else:
+            # Older/minimal checkpoint: the checkpoint's own tree (already
+            # restored into `tree` by CheckpointManager.restore) is the
+            # best known state.
+            best_t.save(tree, inst.likelihood)
         best_trav = determine_rearrangement_setting(
-            inst, tree, ctx, opts, best_t, bt, best_ml, checkpoint_cb)
+            inst, tree, ctx, opts, best_t, bt, best_ml, ckpt)
         opts.log(f"best rearrangement radius: {best_trav}")
         if opts.estimate_model:
             mod_opt(inst, tree, 5.0)
@@ -253,7 +260,7 @@ def compute_big_rapid(inst: PhyloInstance, tree: Tree,
             opts.log(f"user-defined rearrangement radius: {best_trav}")
         else:
             best_trav = determine_rearrangement_setting(
-                inst, tree, ctx, opts, best_t, bt, best_ml, checkpoint_cb)
+                inst, tree, ctx, opts, best_t, bt, best_ml, ckpt)
             opts.log(f"best rearrangement radius: {best_trav}")
 
         if opts.estimate_model:

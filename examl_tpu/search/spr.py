@@ -60,11 +60,11 @@ class SprContext:
         self.constraint = None
 
 
+from examl_tpu.utils import z_slots
+
+
 def _zvec(inst: PhyloInstance, z) -> np.ndarray:
-    z = np.atleast_1d(np.asarray(z, dtype=np.float64))
-    if len(z) != inst.num_branch_slots:
-        z = np.full(inst.num_branch_slots, z[0])
-    return z
+    return z_slots(z, inst.num_branch_slots)
 
 
 def remove_node(inst: PhyloInstance, tree: Tree, ctx: SprContext,

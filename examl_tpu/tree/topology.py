@@ -188,17 +188,20 @@ class Tree:
     def compute_traversal(self, p: Node, full: bool) -> List[TraversalEntry]:
         """Post-order list of CLV updates so that slot p's CLV is valid.
 
-        Partial traversals stop at inner nodes whose x flag is already
-        oriented correctly (reference `computeTraversalInfo`,
-        `newviewGenericSpecial.c:691-813`); full traversals recompute every
-        inner node below p.
+        The top node p is ALWAYS recomputed (orientation flags do not track
+        branch-length changes, so the point-of-use CLV must be refreshed);
+        partial traversals prune only descendants whose x flag is already
+        oriented correctly.  Exactly the reference `computeTraversalInfo`
+        semantics (`newviewGenericSpecial.c:691-813`: children recurse only
+        on `!x || !partialTraversal`, while p itself is appended
+        unconditionally).
         """
         entries: List[TraversalEntry] = []
 
-        def rec(s: Node) -> None:
+        def rec(s: Node, top: bool = False) -> None:
             if self.is_tip(s.number):
                 return
-            if not full and s.x:
+            if not full and s.x and not top:
                 return
             q = s.next.back
             r = s.next.next.back
@@ -207,7 +210,7 @@ class Tree:
             entries.append(TraversalEntry(s.number, q.number, r.number, q.z, r.z))
             self.orient(s)
 
-        rec(p)
+        rec(p, top=True)
         return entries
 
     @staticmethod

@@ -1,0 +1,26 @@
+"""Small shared helpers."""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import numpy as np
+
+
+def z_slots(z: "Sequence[float] | float", num_slots: int) -> np.ndarray:
+    """Normalize a branch-length vector to [num_slots] float64.
+
+    A scalar (or length-1 vector) broadcasts to every branch slot; longer
+    vectors are truncated (a tree built with more slots than the instance
+    uses).  The single source of truth for the reference's
+    z[NUM_BRANCHES] handling (`axml.h:134`, branch vectors sized by
+    numBranches but often written from scalars).
+    """
+    z = np.atleast_1d(np.asarray(z, dtype=np.float64))
+    if len(z) == num_slots:
+        return z
+    if len(z) == 1:
+        return np.full(num_slots, z[0])
+    if len(z) > num_slots:
+        return z[:num_slots]
+    raise ValueError(f"branch vector length {len(z)} vs slots {num_slots}")

@@ -1,0 +1,65 @@
+"""byteFile format: roundtrip fidelity and reference-parser compatibility."""
+
+import os
+
+import numpy as np
+import pytest
+
+from examl_tpu.instance import PhyloInstance
+from examl_tpu.io.alignment import load_alignment
+from examl_tpu.io.bytefile import read_bytefile, write_bytefile
+
+from tests.conftest import TESTDATA
+
+# A byteFile produced by the reference parser (parser/axml.c) if one has
+# been generated locally; the roundtrip tests do not require it.
+REF_BYTEFILE = "/tmp/t49.binary"
+
+
+@pytest.fixture(scope="module")
+def data49():
+    return load_alignment(f"{TESTDATA}/49", f"{TESTDATA}/49.model")
+
+
+@pytest.fixture(scope="module")
+def tree49_text():
+    with open(f"{TESTDATA}/49.tree") as f:
+        return f.read()
+
+
+def test_write_read_roundtrip_exact(tmp_path_factory, data49, tree49_text):
+    path = str(tmp_path_factory.mktemp("bf") / "t49.binary")
+    write_bytefile(path, data49)
+    rt = read_bytefile(path)
+    assert rt.taxon_names == data49.taxon_names
+    for a, b in zip(rt.partitions, data49.partitions):
+        assert a.name == b.name
+        assert a.datatype.name == b.datatype.name
+        np.testing.assert_array_equal(a.patterns, b.patterns)
+        np.testing.assert_array_equal(a.weights, b.weights)
+        np.testing.assert_allclose(a.empirical_freqs, b.empirical_freqs)
+    i1 = PhyloInstance(data49)
+    t1 = i1.tree_from_newick(tree49_text)
+    i2 = PhyloInstance(rt)
+    t2 = i2.tree_from_newick(tree49_text)
+    assert i1.evaluate(t1, full=True) == pytest.approx(
+        i2.evaluate(t2, full=True), abs=1e-9)
+
+
+@pytest.mark.skipif(not os.path.exists(REF_BYTEFILE),
+                    reason="reference parser output not present")
+def test_read_reference_parser_output(data49, tree49_text):
+    """Our reader consumes the reference parser's binary; patterns and
+    weights agree exactly, lnL agrees to the empirical-frequency rounding
+    (the file stores the parser's own EM-smoothed frequencies)."""
+    bf = read_bytefile(REF_BYTEFILE)
+    assert bf.ntaxa == data49.ntaxa
+    for a, b in zip(bf.partitions, data49.partitions):
+        assert a.width == b.width
+        assert int(a.weights.sum()) == int(b.weights.sum())
+    i1 = PhyloInstance(bf)
+    t1 = i1.tree_from_newick(tree49_text)
+    i2 = PhyloInstance(data49)
+    t2 = i2.tree_from_newick(tree49_text)
+    assert i1.evaluate(t1, full=True) == pytest.approx(
+        i2.evaluate(t2, full=True), abs=0.01)

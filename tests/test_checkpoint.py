@@ -1,0 +1,110 @@
+"""Checkpoint/restart: numbered files, flag compatibility, resume parity,
+and the RF convergence criterion."""
+
+import glob
+
+import numpy as np
+import pytest
+
+from examl_tpu.instance import PhyloInstance
+from examl_tpu.io.alignment import build_alignment_data
+from examl_tpu.search.checkpoint import CheckpointManager
+from examl_tpu.search.convergence import RfConvergence, relative_rf
+from examl_tpu.search.raxml_search import SearchOptions, compute_big_rapid
+from examl_tpu.search.snapshots import topology_key
+
+
+def _correlated_dna(ntaxa, nsites, seed=42, mut=0.15):
+    rng = np.random.default_rng(seed)
+    cur = rng.integers(0, 4, nsites)
+    seqs = []
+    for _ in range(ntaxa):
+        flip = rng.random(nsites) < mut
+        cur = np.where(flip, rng.integers(0, 4, nsites), cur)
+        seqs.append("".join("ACGT"[c] for c in cur))
+    return build_alignment_data([f"t{i}" for i in range(ntaxa)], seqs)
+
+
+def test_relative_rf():
+    inst = PhyloInstance(_correlated_dna(10, 60, seed=3))
+    t1 = inst.random_tree(seed=1)
+    t2 = inst.random_tree(seed=2)
+    k1, k2 = topology_key(t1), topology_key(t2)
+    assert relative_rf(k1, k1, 10) == 0.0
+    assert 0.0 < relative_rf(k1, k2, 10) <= 1.0
+
+
+def test_rf_convergence_signals_on_identical_trees():
+    inst = PhyloInstance(_correlated_dna(10, 60, seed=3))
+    t = inst.random_tree(seed=1)
+    conv = RfConvergence(10)
+    assert not conv(t, "fast", 0)          # first cycle: nothing to compare
+    assert conv(t, "fast", 1)              # identical tree: rrf == 0
+    t2 = inst.random_tree(seed=2)
+    conv2 = RfConvergence(10)
+    assert not conv2(t, "fast", 0)
+    assert not conv2(t2, "fast", 1)        # different topology: no signal
+
+
+def test_checkpoint_write_restore_refuses_mismatch(tmp_path):
+    inst = PhyloInstance(_correlated_dna(10, 80))
+    tree = inst.random_tree(seed=0)
+    inst.evaluate(tree, full=True)
+    mgr = CheckpointManager(str(tmp_path), "run1")
+    p1 = mgr.write("FAST_SPRS", {"impr": True}, inst, tree)
+    p2 = mgr.write("FAST_SPRS", {"impr": False}, inst, tree)
+    assert p1 != p2
+    assert len(glob.glob(str(tmp_path / "*.json.gz"))) == 2
+
+    # Same config restores fine.
+    inst2 = PhyloInstance(_correlated_dna(10, 80))
+    tree2 = inst2.random_tree(seed=5)
+    resume = CheckpointManager(str(tmp_path), "run1").restore(inst2, tree2)
+    assert resume["state"] == "FAST_SPRS"
+    assert resume["extras"]["impr"] is False
+    assert topology_key(tree2) == topology_key(tree)
+    assert inst2.likelihood == pytest.approx(inst.likelihood, abs=1e-6)
+
+    # Different alignment shape must be refused.
+    inst3 = PhyloInstance(_correlated_dna(10, 90))
+    with pytest.raises(ValueError, match="different run configuration"):
+        CheckpointManager(str(tmp_path), "run1").restore(
+            inst3, inst3.random_tree(seed=1))
+
+
+def test_checkpoint_counter_resumes_numbering(tmp_path):
+    inst = PhyloInstance(_correlated_dna(10, 80))
+    tree = inst.random_tree(seed=0)
+    inst.evaluate(tree, full=True)
+    mgr = CheckpointManager(str(tmp_path), "r")
+    mgr.write("FAST_SPRS", {}, inst, tree)
+    mgr2 = CheckpointManager(str(tmp_path), "r")
+    assert mgr2.counter == 1               # continues, never overwrites
+
+
+@pytest.mark.slow
+def test_restart_reaches_continuous_result(tmp_path):
+    """Search restarted from a mid-run checkpoint lands at (or above) the
+    continuous run's final likelihood (reference restart semantics)."""
+    data = _correlated_dna(13, 250, seed=11)
+
+    inst = PhyloInstance(data)
+    tree = inst.random_tree(seed=4)
+    inst.evaluate(tree, full=True)
+    mgr = CheckpointManager(str(tmp_path), "cont")
+    opts = SearchOptions(initial_set=True, initial=5)
+    res = compute_big_rapid(inst, tree, opts,
+                            checkpoint_cb=mgr.callback(inst, tree))
+    assert mgr.counter >= 2
+
+    # Restart from an intermediate checkpoint (first FAST_SPRS write).
+    paths = sorted(glob.glob(str(tmp_path / "*.json.gz")),
+                   key=lambda p: int(p.split("ckpt_")[1].split(".")[0]))
+    mid = paths[min(1, len(paths) - 1)]
+    inst2 = PhyloInstance(data)
+    tree2 = inst2.random_tree(seed=99)     # overwritten by restore
+    resume = CheckpointManager(str(tmp_path), "cont").restore(
+        inst2, tree2, path=mid)
+    res2 = compute_big_rapid(inst2, tree2, SearchOptions(
+        initial_set=True, initial=5), resume=resume)
+    assert res2.likelihood >= res.likelihood - 0.5

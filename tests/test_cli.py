@@ -1,0 +1,74 @@
+"""CLI surface: the parser and driver front-ends."""
+
+import numpy as np
+import pytest
+
+from examl_tpu.cli.main import build_argparser
+from examl_tpu.cli.parse import main as parse_main
+
+from tests.conftest import TESTDATA
+
+
+def test_parse_cli_writes_bytefile(tmp_path, capsys):
+    out = tmp_path / "t49"
+    rc = parse_main(["-s", f"{TESTDATA}/49", "-q", f"{TESTDATA}/49.model",
+                     "-m", "DNA", "-n", str(out)])
+    assert rc == 0
+    assert (tmp_path / "t49.binary").exists()
+    text = capsys.readouterr().out
+    assert "unique patterns" in text
+    assert "GAMMA" in text          # memory forecast printed
+
+    from examl_tpu.io.bytefile import read_bytefile
+    data = read_bytefile(str(out) + ".binary")
+    assert data.ntaxa == 49
+    assert len(data.partitions) == 4   # 3 DNA genes, gene2 split by codon?
+
+
+def test_driver_flags_parse():
+    ap = build_argparser()
+    args = ap.parse_args(["-s", "x.binary", "-n", "R", "-t", "t.nwk",
+                          "-f", "d", "-D", "-B", "5", "-M", "-i", "10",
+                          "-e", "0.5", "-w", "/tmp/w"])
+    assert args.mode == "d" and args.rf_convergence and args.save_best == 5
+    assert args.per_partition_bl and args.initial == 10
+
+    with pytest.raises(SystemExit):
+        ap.parse_args(["-s", "x", "-n", "R", "-f", "z"])
+
+
+@pytest.mark.slow
+def test_driver_search_end_to_end(tmp_path):
+    """Tiny full -f d run through the CLI: result + log + model files."""
+    from examl_tpu.cli.main import main as run_main
+    from examl_tpu.io.alignment import build_alignment_data
+    from examl_tpu.io.bytefile import write_bytefile
+
+    rng = np.random.default_rng(0)
+    cur = rng.integers(0, 4, 200)
+    seqs = []
+    for _ in range(10):
+        flip = rng.random(200) < 0.15
+        cur = np.where(flip, rng.integers(0, 4, 200), cur)
+        seqs.append("".join("ACGT"[c] for c in cur))
+    data = build_alignment_data([f"t{i}" for i in range(10)], seqs)
+    write_bytefile(str(tmp_path / "a.binary"), data)
+
+    # starting tree from random topology
+    from examl_tpu.instance import PhyloInstance
+    inst = PhyloInstance(data)
+    t = inst.random_tree(seed=3)
+    (tmp_path / "start.nwk").write_text(
+        t.to_newick(data.taxon_names))
+
+    rc = run_main(["-s", str(tmp_path / "a.binary"), "-n", "E2E",
+                   "-t", str(tmp_path / "start.nwk"), "-f", "d",
+                   "-i", "5", "-w", str(tmp_path)])
+    assert rc == 0
+    assert (tmp_path / "ExaML_result.E2E").read_text().startswith("(")
+    log_rows = (tmp_path / "ExaML_log.E2E").read_text().splitlines()
+    assert len(log_rows) >= 2
+    final = float(log_rows[-1].split()[1])
+    first = float(log_rows[0].split()[1])
+    assert final > first
+    assert "alpha" in (tmp_path / "ExaML_modelFile.E2E").read_text()

@@ -1,0 +1,110 @@
+"""Quartet evaluation (-f q): flavors, grouping parser, output format."""
+
+import re
+
+import numpy as np
+import pytest
+
+from examl_tpu.instance import PhyloInstance
+from examl_tpu.io.alignment import build_alignment_data
+from examl_tpu.search.quartets import (QuartetOptions, compute_quartets,
+                                       parse_grouping_file)
+
+
+@pytest.fixture(scope="module")
+def inst8():
+    rng = np.random.default_rng(5)
+    cur = rng.integers(0, 4, 200)
+    seqs = []
+    for _ in range(8):
+        flip = rng.random(200) < 0.2
+        cur = np.where(flip, rng.integers(0, 4, 200), cur)
+        seqs.append("".join("ACGT"[c] for c in cur))
+    return PhyloInstance(
+        build_alignment_data([f"t{i}" for i in range(8)], seqs))
+
+
+def test_grouping_parser(tmp_path, inst8):
+    path = tmp_path / "groups.txt"
+    path.write_text("(t0, t1), (t2,t3), (t4), (t5, t6, t7)\n")
+    groups = parse_grouping_file(str(path), inst8.alignment.taxon_names)
+    assert groups == [[1, 2], [3, 4], [5], [6, 7, 8]]
+    bad = tmp_path / "bad.txt"
+    bad.write_text("(t0), (t1), (t0), (t2)")
+    with pytest.raises(ValueError, match="two groups"):
+        parse_grouping_file(str(bad), inst8.alignment.taxon_names)
+
+
+@pytest.mark.slow
+def test_all_quartets_output(tmp_path, inst8):
+    tree = inst8.random_tree(seed=1)
+    out = str(tmp_path / "q.out")
+    n = compute_quartets(inst8, tree, QuartetOptions(epsilon=1.0), out)
+    assert n == 70                              # C(8,4)
+    lines = [l for l in open(out) if "|" in l]
+    assert len(lines) == 210                    # 3 topologies each
+    assert all(re.match(r"\d+ \d+ \| \d+ \d+: -\d+\.\d+", l)
+               for l in lines)
+
+
+@pytest.mark.slow
+def test_grouped_quartets(tmp_path, inst8):
+    gfile = tmp_path / "groups.txt"
+    gfile.write_text("(t0,t1),(t2),(t4),(t6,t7)")
+    tree = inst8.random_tree(seed=1)
+    out = str(tmp_path / "qg.out")
+    n = compute_quartets(
+        inst8, tree,
+        QuartetOptions(grouping_file=str(gfile), epsilon=1.0), out)
+    assert n == 2 * 1 * 1 * 2
+    lines = [l for l in open(out) if "|" in l]
+    assert len(lines) == 12
+
+
+@pytest.mark.slow
+def test_quartet_checkpoint_restart(tmp_path, inst8):
+    """Resumed quartet run reproduces the continuous run's output file."""
+    from examl_tpu.search.checkpoint import CheckpointManager
+
+    tree = inst8.random_tree(seed=1)
+    out = str(tmp_path / "q.out")
+    mgr = CheckpointManager(str(tmp_path), "q")
+    n = compute_quartets(
+        inst8, tree,
+        QuartetOptions(epsilon=1.0, checkpoint_interval=30,
+                       checkpoint_mgr=mgr), out)
+    assert n == 70 and mgr.counter >= 2
+    continuous = open(out).read()
+
+    # Restart from the newest checkpoint with a fresh instance: truncates
+    # to the checkpointed position and recomputes the tail.
+    import numpy as np
+    rng = np.random.default_rng(5)
+    cur = rng.integers(0, 4, 200)
+    seqs = []
+    for _ in range(8):
+        flip = rng.random(200) < 0.2
+        cur = np.where(flip, rng.integers(0, 4, 200), cur)
+        seqs.append("".join("ACGT"[c] for c in cur))
+    from examl_tpu.io.alignment import build_alignment_data
+    inst2 = PhyloInstance(
+        build_alignment_data([f"t{i}" for i in range(8)], seqs))
+    tree2 = inst2.random_tree(seed=9)
+    resume = CheckpointManager(str(tmp_path), "q").restore(inst2, tree2)
+    assert resume["state"] == "QUARTETS"
+    n2 = compute_quartets(
+        inst2, tree2, QuartetOptions(epsilon=1.0, resume=resume), out)
+    assert n2 == 70
+    resumed = open(out).read()
+    assert resumed == continuous
+
+
+@pytest.mark.slow
+def test_random_quartet_sampling(tmp_path, inst8):
+    tree = inst8.random_tree(seed=1)
+    out = str(tmp_path / "qr.out")
+    n = compute_quartets(
+        inst8, tree, QuartetOptions(random_samples=10, epsilon=1.0), out)
+    assert n >= 10                              # counter includes skipped
+    lines = [l for l in open(out) if "|" in l]
+    assert len(lines) == 30

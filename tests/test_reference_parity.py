@@ -1,0 +1,60 @@
+"""Golden parity against the actual reference binaries (built via
+tools/build_reference.sh with the single-rank MPI shim).  Skipped when the
+binaries have not been built locally."""
+
+import os
+import re
+import subprocess
+
+import pytest
+
+from examl_tpu.instance import PhyloInstance
+from examl_tpu.io.alignment import load_alignment
+
+from tests.conftest import TESTDATA
+
+REF_EXAML = "/tmp/refexaml/examl-AVX"
+REF_PARSER = "/tmp/refparser/parse-examl"
+
+pytestmark = pytest.mark.skipif(
+    not (os.path.exists(REF_EXAML) and os.path.exists(REF_PARSER)),
+    reason="reference binaries not built (run tools/build_reference.sh)")
+
+
+def _ref_tree_eval(tmp, aln, model, tree) -> float:
+    """Run reference `examl -f e` and return its optimized lnL.
+
+    The reference parser asserts on absolute -n names; run it with a
+    relative name inside tmp."""
+    subprocess.run([REF_PARSER, "-s", aln, "-q", model, "-m", "DNA",
+                    "-n", "aln"], check=True, cwd=tmp,
+                   capture_output=True)
+    out = os.path.join(tmp, "out")
+    os.makedirs(out, exist_ok=True)
+    subprocess.run([REF_EXAML, "-s", "aln.binary", "-t", tree,
+                    "-m", "GAMMA", "-n", "REF", "-f", "e", "-w", out + "/"],
+                   check=True, cwd=tmp, capture_output=True, timeout=600)
+    info = open(os.path.join(out, "ExaML_info.REF")).read()
+    m = re.search(r"Likelihood tree 0: (-?\d+\.\d+)", info)
+    assert m, info
+    return float(m.group(1))
+
+
+@pytest.mark.slow
+def test_tree_evaluation_matches_reference(tmp_path):
+    """-f e on testData/49: our optimized lnL lands within 0.1 of the
+    reference's (both are Brent/NR local optimization endpoints)."""
+    ref_lnl = _ref_tree_eval(str(tmp_path), f"{TESTDATA}/49",
+                             f"{TESTDATA}/49.model", f"{TESTDATA}/49.tree")
+
+    from examl_tpu.optimize.branch import tree_evaluate
+    from examl_tpu.optimize.model_opt import mod_opt
+    inst = PhyloInstance(load_alignment(f"{TESTDATA}/49",
+                                        f"{TESTDATA}/49.model"))
+    with open(f"{TESTDATA}/49.tree") as f:
+        tree = inst.tree_from_newick(f.read())
+    inst.evaluate(tree, full=True)
+    tree_evaluate(inst, tree, 1.0)
+    mod_opt(inst, tree, 0.1)
+
+    assert inst.likelihood == pytest.approx(ref_lnl, abs=0.1)

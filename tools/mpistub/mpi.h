@@ -1,0 +1,81 @@
+/* Minimal single-rank MPI shim: lets the reference ExaML build and run as
+ * one process for golden-value parity tests and baseline benchmarks (no
+ * MPI toolchain ships in this image).  Covers exactly the symbols the
+ * reference uses (see SURVEY.md §5.8); every collective degenerates to a
+ * local copy or no-op, which is semantically exact for a single rank. */
+#ifndef MPISTUB_H
+#define MPISTUB_H
+
+#include <stddef.h>
+#include <string.h>
+#include <stdlib.h>
+
+typedef int MPI_Comm;
+typedef int MPI_Datatype;
+typedef int MPI_Op;
+
+#define MPI_COMM_WORLD 0
+#define MPI_DOUBLE 0
+#define MPI_INT 1
+#define MPI_UNSIGNED_LONG 2
+#define MPI_SUM 0
+#define MPI_IN_PLACE ((void *) -1)
+#define MPI_SUCCESS 0
+
+static size_t mpistub_size(MPI_Datatype t)
+{
+  switch (t) {
+  case MPI_DOUBLE: return sizeof(double);
+  case MPI_INT: return sizeof(int);
+  case MPI_UNSIGNED_LONG: return sizeof(unsigned long);
+  default: abort();
+  }
+}
+
+static int MPI_Init(int *argc, char ***argv) { (void)argc; (void)argv; return MPI_SUCCESS; }
+static int MPI_Finalize(void) { return MPI_SUCCESS; }
+static int MPI_Comm_rank(MPI_Comm c, int *rank) { (void)c; *rank = 0; return MPI_SUCCESS; }
+static int MPI_Comm_size(MPI_Comm c, int *size) { (void)c; *size = 1; return MPI_SUCCESS; }
+static int MPI_Barrier(MPI_Comm c) { (void)c; return MPI_SUCCESS; }
+static int MPI_Abort(MPI_Comm c, int code) { (void)c; exit(code); }
+static int MPI_Bcast(void *buf, int n, MPI_Datatype t, int root, MPI_Comm c)
+{ (void)buf; (void)n; (void)t; (void)root; (void)c; return MPI_SUCCESS; }
+
+static int MPI_Allreduce(void *send, void *recv, int n, MPI_Datatype t,
+                         MPI_Op op, MPI_Comm c)
+{
+  (void)op; (void)c;
+  if (send != MPI_IN_PLACE)
+    memcpy(recv, send, (size_t)n * mpistub_size(t));
+  return MPI_SUCCESS;
+}
+
+static int MPI_Reduce(void *send, void *recv, int n, MPI_Datatype t,
+                      MPI_Op op, int root, MPI_Comm c)
+{
+  (void)root;
+  return MPI_Allreduce(send, recv, n, t, op, c);
+}
+
+static int MPI_Gatherv(void *send, int sendcount, MPI_Datatype st,
+                       void *recv, int *recvcounts, int *displs,
+                       MPI_Datatype rt, int root, MPI_Comm c)
+{
+  (void)rt; (void)root; (void)c;
+  memcpy((char *)recv + (size_t)displs[0] * mpistub_size(st),
+         send, (size_t)sendcount * mpistub_size(st));
+  (void)recvcounts;
+  return MPI_SUCCESS;
+}
+
+static int MPI_Scatterv(void *send, int *sendcounts, int *displs,
+                        MPI_Datatype st, void *recv, int recvcount,
+                        MPI_Datatype rt, int root, MPI_Comm c)
+{
+  (void)rt; (void)root; (void)c; (void)sendcounts;
+  memcpy(recv, (char *)send + (size_t)displs[0] * mpistub_size(st),
+         (size_t)recvcount * mpistub_size(st));
+  return MPI_SUCCESS;
+}
+
+#endif /* MPISTUB_H */
