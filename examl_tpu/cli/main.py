@@ -95,6 +95,7 @@ class RunFiles:
         self.result_path = f"{pre}result.{run_id}"
         self.model_path = f"{pre}modelFile.{run_id}"
         self.treefile_path = f"{pre}TreeFile.{run_id}"
+        self.quartets_path = f"{pre}quartets.{run_id}"
         self.start_time = time.time()
         if not append:
             for p in (self.info_path, self.log_path):
@@ -124,7 +125,13 @@ def write_model_params(path: str, inst) -> None:
             f.write(f"Partition: {gid} {part.name}\n")
             f.write(f"DataType: {part.datatype.name}\n")
             f.write(f"Substitution model: {name}\n")
-            f.write(f"alpha: {m.alpha:.6f}\n")
+            if getattr(inst, "psr", False):
+                psr = inst.per_site_rates[gid]
+                f.write(f"categories: {len(psr)}\n")
+                f.write("category rates: "
+                        + " ".join(f"{r:.6f}" for r in psr) + "\n")
+            else:
+                f.write(f"alpha: {m.alpha:.6f}\n")
             f.write("rates: " + " ".join(f"{r:.6f}" for r in m.rates) + "\n")
             f.write("freqs: " + " ".join(f"{x:.6f}" for x in m.freqs) + "\n")
             f.write("\n")
@@ -183,7 +190,6 @@ def run_search(args, inst, files: RunFiles) -> int:
         save_best_trees=args.save_best,
         do_cutoff=args.mode != "o",
         search_convergence=args.rf_convergence,
-        likelihood_epsilon=args.epsilon,
         log=log)
     conv = (RfConvergence(inst.alignment.ntaxa, log=files.info)
             if args.rf_convergence else None)

@@ -33,7 +33,7 @@ def run_quartets(args, inst, files) -> int:
         checkpoint_interval=args.quartet_ckpt_interval,
         checkpoint_mgr=mgr,
         resume=resume)
-    out = files.treefile_path.replace("TreeFile", "quartets")
+    out = files.quartets_path
     n = compute_quartets(inst, tree, opts, out, log=files.info)
     files.info(f"{n} quartets written to {out}")
     return 0

@@ -32,11 +32,10 @@ class PhyloInstance:
         from examl_tpu.config import default_dtype
         if rate_model not in ("GAMMA", "PSR"):
             raise ValueError(f"unknown rate model {rate_model!r}")
-        if rate_model == "PSR":
-            raise NotImplementedError(
-                "the PSR per-site-rate model is not available yet; "
-                "use -m GAMMA")
         self.rate_model = rate_model
+        self.psr = rate_model == "PSR"
+        if self.psr:
+            ncat = 1                      # one rate per site, weight 1
         self.psr_categories = psr_categories
         self.save_memory = save_memory       # SEV mode: planned, accepted now
         self.alignment = alignment
@@ -77,7 +76,18 @@ class PhyloInstance:
                 bucket, [self.models[g] for g in bucket.part_ids],
                 alignment.ntaxa, num_branch_slots=self.num_branch_slots,
                 branch_indices=branch_indices, dtype=self.dtype,
-                sharding=sharding)
+                sharding=sharding, psr=self.psr)
+
+        # PSR per-site rate state (reference patrat / rateCategory /
+        # perSiteRates, `axml.h:585-600`): host copies per partition.
+        if self.psr:
+            self.patrat = [np.ones(p.width) for p in alignment.partitions]
+            self.site_lhs = [np.zeros(p.width) for p in alignment.partitions]
+            self.rate_category = [np.zeros(p.width, dtype=np.int32)
+                                  for p in alignment.partitions]
+            self.per_site_rates = [np.ones(1) for _ in alignment.partitions]
+            self.psr_invocations = 0
+            self.cat_opt_rounds = 0
 
         self.per_partition_lnl = np.full(M, np.nan)
         self.likelihood = np.nan
@@ -98,6 +108,17 @@ class PhyloInstance:
         self.models[gid] = model
         if push:
             self.push_models()
+
+    def push_site_rates(self) -> None:
+        """Install the per-partition patrat vectors into the engines'
+        packed [B, lane] site-rate buffers (padding sites keep rate 1)."""
+        assert self.psr
+        for states, bucket in self.buckets.items():
+            packed = np.ones(bucket.num_sites)
+            for li, gid in enumerate(bucket.part_ids):
+                packed[bucket.site_indices(li)] = self.patrat[gid]
+            self.engines[states].set_site_rates(
+                packed.reshape(bucket.num_blocks, bucket.lane))
 
     # -- tree construction -------------------------------------------------
 

@@ -130,7 +130,10 @@ def read_bytefile(path: str) -> AlignmentData:
         (num_pattern,) = _r(f, "Q")
         (num_parts,) = _r(f, "i")
         _r(f, "d")                                    # gappyness (stats only)
-        weights = np.frombuffer(f.read(4 * num_pattern), dtype="<i4")
+        wbytes = f.read(4 * num_pattern)
+        if len(wbytes) != 4 * num_pattern:
+            raise ValueError("truncated byteFile")
+        weights = np.frombuffer(wbytes, dtype="<i4")
         names = [_read_string(f) for _ in range(ntaxa)]
         metas = []
         for _ in range(num_parts):

@@ -27,20 +27,25 @@ from examl_tpu.ops import kernels
 from examl_tpu.ops.kernels import DeviceModels, Traversal
 from examl_tpu.parallel.packing import PackedBucket
 from examl_tpu.tree.topology import TraversalEntry
+from examl_tpu.utils import z_slots as _z_slots
 
 
 def stack_models(models: Sequence[ModelParams],
-                 branch_indices: Sequence[int], dtype) -> DeviceModels:
+                 branch_indices: Sequence[int], dtype,
+                 psr: bool = False) -> DeviceModels:
     R = models[0].ncat
     assert all(m.ncat == R for m in models)
     arr = lambda xs: jnp.asarray(np.stack(xs), dtype=dtype)
+    # PSR: one "category" per site with weight 1 (the site's own rate);
+    # GAMMA: R equiprobable categories.
+    weight = 1.0 if psr else 1.0 / R
     return DeviceModels(
         eign=arr([m.eign for m in models]),
         ev=arr([m.ev for m in models]),
         ei=arr([m.ei for m in models]),
         freqs=arr([m.freqs for m in models]),
         gamma_rates=arr([m.gamma_rates for m in models]),
-        rate_weights=arr([np.full(R, 1.0 / R) for m in models]),
+        rate_weights=arr([np.full(R, weight) for m in models]),
         part_branch=jnp.asarray(np.asarray(branch_indices, dtype=np.int32)),
     )
 
@@ -69,9 +74,11 @@ class LikelihoodEngine:
                  ntips: int, num_branch_slots: int = 1,
                  branch_indices: Optional[Sequence[int]] = None,
                  dtype=jnp.float64, sharding=None,
-                 scale_exp: Optional[int] = None, wave_width: int = 8):
+                 scale_exp: Optional[int] = None, wave_width: int = 8,
+                 psr: bool = False):
         self.bucket = bucket
         self.ntips = ntips
+        self.psr = psr
         self.dtype = jnp.dtype(dtype)
         self.scale_exp = (scale_exp if scale_exp is not None
                           else kernels.default_scale_exponent(self.dtype))
@@ -91,7 +98,12 @@ class LikelihoodEngine:
         if branch_indices is None:
             branch_indices = [0] * self.num_parts
         self._branch_indices = list(branch_indices)
-        self.models = stack_models(models, branch_indices, self.dtype)
+        self.models = stack_models(models, branch_indices, self.dtype,
+                                   psr=psr)
+        # Per-site rate multipliers (PSR/CAT model); None selects the
+        # GAMMA path in every kernel.
+        self.site_rates = (jnp.ones((B, lane, 1), dtype=self.dtype)
+                           if psr else None)
 
         self.block_part = jnp.asarray(bucket.block_part)
         self.weights = jnp.asarray(
@@ -110,10 +122,11 @@ class LikelihoodEngine:
         # One jitted traversal program; jax recompiles per padded entry-count
         # shape (powers of two, so only a handful of variants exist).  The
         # CLV/scaler buffers are donated: they are replaced by the outputs,
-        # never read again.
+        # never read again.  site_rates rides along as a traced argument
+        # (None on the GAMMA path).
         self._jit_traverse = jax.jit(
-            lambda clv, scaler, tv, dm, block_part: kernels.traverse(
-                dm, block_part, clv, scaler, tv, self.scale_exp),
+            lambda clv, scaler, tv, dm, block_part, sr: kernels.traverse(
+                dm, block_part, clv, scaler, tv, self.scale_exp, sr),
             donate_argnums=(0, 1))
         self._jit_evaluate = jax.jit(self._evaluate_impl)
         self._jit_trav_eval = jax.jit(self._trav_eval_impl,
@@ -121,6 +134,7 @@ class LikelihoodEngine:
         self._jit_newton = jax.jit(self._newton_impl, donate_argnums=(0, 1))
         self._jit_sumtable = jax.jit(self._sumtable_impl)
         self._jit_derivs = jax.jit(self._derivs_impl)
+        self._jit_rate_scan = jax.jit(self._rate_scan_impl)
 
     # -- construction helpers ---------------------------------------------
 
@@ -148,7 +162,8 @@ class LikelihoodEngine:
         self.block_part = jax.device_put(self.block_part, sharding.blocks)
 
     def set_models(self, models: Sequence[ModelParams]) -> None:
-        self.models = stack_models(models, self._branch_indices, self.dtype)
+        self.models = stack_models(models, self._branch_indices, self.dtype,
+                                   psr=self.psr)
 
     def invalidate_tips_changed(self) -> None:
         self.clv = self.clv.at[:self.ntips].set(self._build_tip_clvs())
@@ -192,20 +207,27 @@ class LikelihoodEngine:
                          zl=jnp.asarray(zl, dtype=self.dtype),
                          zr=jnp.asarray(zr, dtype=self.dtype))
 
+    def set_site_rates(self, rates: np.ndarray) -> None:
+        """Install per-site rate multipliers [B, lane] (PSR model)."""
+        assert self.psr
+        self.site_rates = jnp.asarray(
+            rates.reshape(self.B, self.lane, 1), dtype=self.dtype)
+
     def run_traversal(self, entries: List[TraversalEntry]) -> None:
         if not entries:
             return
         tv = self._traversal_arrays(entries)
         self.clv, self.scaler = self._jit_traverse(
-            self.clv, self.scaler, tv, self.models, self.block_part)
+            self.clv, self.scaler, tv, self.models, self.block_part,
+            self.site_rates)
 
     # -- evaluation --------------------------------------------------------
 
     def _evaluate_impl(self, clv, scaler, p_row, q_row, z, dm, block_part,
-                       weights):
+                       weights, sr):
         return kernels.root_log_likelihood(
             dm, block_part, weights, clv, scaler,
-            p_row, q_row, z, self.num_parts, self.scale_exp)
+            p_row, q_row, z, self.num_parts, self.scale_exp, sr)
 
     def evaluate(self, p_num: int, q_num: int, z: Sequence[float]) -> np.ndarray:
         """Per-partition lnL [M] at branch (p,q); CLVs must be current."""
@@ -213,7 +235,7 @@ class LikelihoodEngine:
         out = self._jit_evaluate(self.clv, self.scaler,
                                  jnp.int32(p_num - 1), jnp.int32(q_num - 1),
                                  zv, self.models, self.block_part,
-                                 self.weights)
+                                 self.weights, self.site_rates)
         return np.asarray(out)
 
     # -- fused single-dispatch entry points ---------------------------------
@@ -223,12 +245,12 @@ class LikelihoodEngine:
     # search step is a single dispatch.
 
     def _trav_eval_impl(self, clv, scaler, tv, p_row, q_row, z, dm,
-                        block_part, weights):
+                        block_part, weights, sr):
         clv, scaler = kernels.traverse(dm, block_part, clv, scaler, tv,
-                                       self.scale_exp)
+                                       self.scale_exp, sr)
         lnl = kernels.root_log_likelihood(
             dm, block_part, weights, clv, scaler, p_row, q_row, z,
-            self.num_parts, self.scale_exp)
+            self.num_parts, self.scale_exp, sr)
         return clv, scaler, lnl
 
     def traverse_evaluate(self, entries: List[TraversalEntry], p_num: int,
@@ -238,17 +260,17 @@ class LikelihoodEngine:
         self.clv, self.scaler, out = self._jit_trav_eval(
             self.clv, self.scaler, tv, jnp.int32(p_num - 1),
             jnp.int32(q_num - 1), zv, self.models, self.block_part,
-            self.weights)
+            self.weights, self.site_rates)
         return np.asarray(out)
 
     def _newton_impl(self, clv, scaler, tv, p_row, q_row, z0, maxiters,
-                     conv, dm, block_part, weights):
+                     conv, dm, block_part, weights, sr):
         clv, scaler = kernels.traverse(dm, block_part, clv, scaler, tv,
-                                       self.scale_exp)
+                                       self.scale_exp, sr)
         st = kernels.sumtable(dm, block_part, clv[p_row], clv[q_row])
         z = kernels.newton_raphson_branch(dm, block_part, weights, st, z0,
                                           maxiters, conv,
-                                          self.num_branch_slots)
+                                          self.num_branch_slots, sr)
         return clv, scaler, z
 
     def newton_branch(self, entries: List[TraversalEntry], p_num: int,
@@ -263,17 +285,56 @@ class LikelihoodEngine:
             self.clv, self.scaler, tv, jnp.int32(p_num - 1),
             jnp.int32(q_num - 1), jnp.asarray(z0),
             jnp.full(C, maxiter, dtype=jnp.int32), jnp.asarray(conv_mask),
-            self.models, self.block_part, self.weights)
+            self.models, self.block_part, self.weights, self.site_rates)
         return np.asarray(z, dtype=np.float64)
+
+    # -- PSR rate-grid scan -------------------------------------------------
+
+    def _rate_scan_impl(self, tips, tv, p_row, q_row, z, grid, dm,
+                        block_part):
+        """Full traversal + per-site-per-candidate root lnL for one grid
+        chunk [B, lane, G]; scratch CLVs live only inside this program."""
+        G = grid.shape[2]
+        clv = jnp.zeros((self.num_rows, self.B, self.lane, G, self.K),
+                        dtype=self.dtype)
+        clv = clv.at[:self.ntips].set(
+            jnp.broadcast_to(tips, (self.ntips, self.B, self.lane, G,
+                                    self.K)))
+        scaler = jnp.zeros((self.num_rows, self.B, self.lane),
+                           dtype=jnp.int32)
+        clv, scaler = kernels.traverse(dm, block_part, clv, scaler, tv,
+                                       self.scale_exp, grid)
+        return kernels.per_rate_site_lnls(dm, block_part, clv, scaler,
+                                          p_row, q_row, z, grid,
+                                          self.scale_exp)
+
+    def rate_scan(self, entries: List[TraversalEntry], p_num: int,
+                  q_num: int, z: Sequence[float],
+                  grid: np.ndarray) -> np.ndarray:
+        """Per-site lnL under each candidate rate: grid [B, lane, G] ->
+        [B, lane, G].  entries must be a FULL traversal for branch (p,q).
+
+        TPU-native replacement for the reference's per-site
+        `evaluatePartialGeneric` scan (SURVEY §7.3(5)).
+        """
+        assert self.psr
+        tv = self._traversal_arrays(entries)
+        zv = jnp.asarray(_z_slots(z, self.num_branch_slots), dtype=self.dtype)
+        out = self._jit_rate_scan(
+            self.clv[:self.ntips], tv, jnp.int32(p_num - 1),
+            jnp.int32(q_num - 1), zv,
+            jnp.asarray(grid, dtype=self.dtype), self.models,
+            self.block_part)
+        return np.asarray(out)
 
     # -- branch derivatives ------------------------------------------------
 
     def _sumtable_impl(self, clv, p_row, q_row, dm, block_part):
         return kernels.sumtable(dm, block_part, clv[p_row], clv[q_row])
 
-    def _derivs_impl(self, st, z, dm, block_part, weights):
+    def _derivs_impl(self, st, z, dm, block_part, weights, sr):
         return kernels.nr_derivatives(dm, block_part, weights,
-                                      st, z, self.num_branch_slots)
+                                      st, z, self.num_branch_slots, sr)
 
     def make_sumtable(self, p_num: int, q_num: int) -> jax.Array:
         return self._jit_sumtable(self.clv, jnp.int32(p_num - 1),
@@ -283,8 +344,7 @@ class LikelihoodEngine:
     def branch_derivatives(self, st: jax.Array, z: Sequence[float]):
         zv = jnp.asarray(_z_slots(z, self.num_branch_slots), dtype=self.dtype)
         d1, d2 = self._jit_derivs(st, zv, self.models, self.block_part,
-                                  self.weights)
+                                  self.weights, self.site_rates)
         return np.asarray(d1), np.asarray(d2)
 
 
-from examl_tpu.utils import z_slots as _z_slots  # noqa: E402

@@ -127,20 +127,64 @@ def p_matrices_wave(models: DeviceModels, z: jax.Array) -> jax.Array:
     return einsum("maj,wmrj,mjk->wmrak", models.ev, d, models.ei)
 
 
+def psr_decay(models: DeviceModels, block_part: jax.Array,
+              site_rates: jax.Array, z: jax.Array) -> jax.Array:
+    """Per-site eigenvalue decay d[b,l,r,j] = exp(eign_j * rate_blr * log z).
+
+    The PSR (CAT) analogue of `branch_decay`: every site carries its own
+    rate multiplier (reference per-site `patrat`/`rateCategory`,
+    `optimizeModel.c:1792-2507`), so the transition matrix differs per
+    site and is never materialized — newview/evaluate apply it in
+    factorized form (EI contraction, decay scaling, EV contraction).
+    site_rates: [B, lane, R] (R = 1 in normal PSR compute; R = G during
+    the batched rate-grid scan).
+    """
+    zb = z[models.part_branch][block_part]                  # [B]
+    lz = jnp.log(zb)
+    eb = models.eign[block_part]                            # [B, K]
+    return jnp.exp(eb[:, None, None, :]
+                   * site_rates[:, :, :, None]
+                   * lz[:, None, None, None])               # [B, lane, R, K]
+
+
+def apply_p_factorized(models: DeviceModels, block_part: jax.Array,
+                       d: jax.Array, x: jax.Array) -> jax.Array:
+    """y = EV · (d * (EI · x)) with per-site decay d [..., B, lane, R, K].
+
+    Equivalent to applying P(z, r_site) without building per-site P
+    matrices; the two contractions are MXU matmuls over the state axis.
+    """
+    eib = models.ei[block_part]                             # [B, K, K]
+    evb = models.ev[block_part]
+    u = einsum("bjk,...blrk->...blrj", eib, x)
+    u = u * d
+    return einsum("baj,...blrj->...blra", evb, u)
+
+
 def newview_wave(models: DeviceModels, block_part: jax.Array,
                  xl: jax.Array, xr: jax.Array,
-                 zl: jax.Array, zr: jax.Array, scale_exp: int):
+                 zl: jax.Array, zr: jax.Array, scale_exp: int,
+                 site_rates=None):
     """Combine child CLVs into parent CLVs for one wave of W entries.
 
     xl, xr: [W, B, lane, R, K]; zl, zr: [W, C].
     Returns (clv [W,B,lane,R,K], scale_inc [W,B,lane]).
-    Reference semantics: `newviewGAMMA_FLEX` (`newviewGenericSpecial.c:430-682`),
-    batched over independent traversal entries.
+    Reference semantics: `newviewGAMMA_FLEX` (`newviewGenericSpecial.c:430-682`)
+    and the CAT kernels when site_rates is given, batched over independent
+    traversal entries.
     """
-    pl = p_matrices_wave(models, zl)[:, block_part]         # [W, B, R, K, K]
-    pr = p_matrices_wave(models, zr)[:, block_part]
-    yl = einsum("wbrak,wblrk->wblra", pl, xl)
-    yr = einsum("wbrak,wblrk->wblra", pr, xr)
+    if site_rates is None:
+        pl = p_matrices_wave(models, zl)[:, block_part]     # [W, B, R, K, K]
+        pr = p_matrices_wave(models, zr)[:, block_part]
+        yl = einsum("wbrak,wblrk->wblra", pl, xl)
+        yr = einsum("wbrak,wblrk->wblra", pr, xr)
+    else:
+        dl = jax.vmap(lambda zz: psr_decay(models, block_part, site_rates,
+                                           zz))(zl)         # [W, B, l, R, K]
+        dr = jax.vmap(lambda zz: psr_decay(models, block_part, site_rates,
+                                           zz))(zr)
+        yl = apply_p_factorized(models, block_part, dl, xl)
+        yr = apply_p_factorized(models, block_part, dr, xr)
     v = yl * yr
     minlik, two_e, _ = scale_constants(v.dtype, scale_exp)
     vmax = jnp.max(jnp.abs(v), axis=(3, 4))                 # [W, B, lane]
@@ -151,7 +195,7 @@ def newview_wave(models: DeviceModels, block_part: jax.Array,
 
 def traverse(models: DeviceModels, block_part: jax.Array,
              clv: jax.Array, scaler: jax.Array, tv: Traversal,
-             scale_exp: int):
+             scale_exp: int, site_rates=None):
     """Execute a wave-scheduled traversal: lax.scan over waves, each wave a
     batched newview over its independent entries.
 
@@ -165,7 +209,7 @@ def traverse(models: DeviceModels, block_part: jax.Array,
         clv, scaler = carry
         parent, left, right, zl, zr = e
         v, inc = newview_wave(models, block_part, clv[left], clv[right],
-                              zl, zr, scale_exp)
+                              zl, zr, scale_exp, site_rates)
         sc = scaler[left] + scaler[right] + inc             # [W, B, lane]
         clv = clv.at[parent].set(v, unique_indices=False)
         scaler = scaler.at[parent].set(sc, unique_indices=False)
@@ -178,22 +222,49 @@ def traverse(models: DeviceModels, block_part: jax.Array,
 
 
 def site_likelihoods(models: DeviceModels, block_part: jax.Array,
-                     xp: jax.Array, xq: jax.Array, z: jax.Array):
+                     xp: jax.Array, xq: jax.Array, z: jax.Array,
+                     site_rates=None):
     """Per-site likelihood L[b,l] at the root branch (p,q) with branch z.
 
     L = sum_r w_r sum_k f_k * xp_k * (P(z) xq)_k
-    Reference: `evaluateGAMMA_FLEX` (`evaluateGenericSpecial.c:154-231`).
+    Reference: `evaluateGAMMA_FLEX` (`evaluateGenericSpecial.c:154-231`) or
+    the CAT evaluate kernels when site_rates is given.
     """
-    y = apply_p(p_matrices(models, z), block_part, xq)      # [B,l,R,K]
+    if site_rates is None:
+        y = apply_p(p_matrices(models, z), block_part, xq)  # [B,l,R,K]
+    else:
+        d = psr_decay(models, block_part, site_rates, z)
+        y = apply_p_factorized(models, block_part, d, xq)
     fb = models.freqs[block_part]                           # [B, K]
     wb = models.rate_weights[block_part]                    # [B, R]
     return einsum("bk,br,blrk,blrk->bl", fb, wb, xp, y)
 
 
+def per_rate_site_lnls(models: DeviceModels, block_part: jax.Array,
+                       clv: jax.Array, scaler: jax.Array, p_row, q_row,
+                       z: jax.Array, site_rates: jax.Array, scale_exp: int):
+    """Per-site, per-rate-candidate log likelihood [B, lane, R].
+
+    The batched on-device replacement for the reference's per-site rate
+    scan (`evaluatePartialGeneric` called once per site per trial rate,
+    `optimizeModel.c:1792-1922`): one traversal per rate-grid chunk
+    produces every site's lnL under every candidate rate at once.
+    """
+    d = psr_decay(models, block_part, site_rates, z)
+    y = apply_p_factorized(models, block_part, d, clv[q_row])
+    fb = models.freqs[block_part]
+    lsite = einsum("bk,blrk,blrk->blr", fb, clv[p_row], y)  # [B, lane, R]
+    acc = _acc_dtype(lsite.dtype)
+    _, _, log_min = scale_constants(acc, scale_exp)
+    sc = (scaler[p_row] + scaler[q_row]).astype(acc)        # [B, lane]
+    lsite = jnp.maximum(lsite, jnp.finfo(lsite.dtype).tiny)
+    return jnp.log(lsite).astype(acc) + sc[:, :, None] * log_min
+
+
 def root_log_likelihood(models: DeviceModels, block_part: jax.Array,
                         weights: jax.Array, clv: jax.Array, scaler: jax.Array,
                         p_row, q_row, z: jax.Array, num_parts: int,
-                        scale_exp: int):
+                        scale_exp: int, site_rates=None):
     """Per-partition log likelihoods [M] after a traversal.
 
     weights: [B, lane] pattern weights (0 on padding).
@@ -201,7 +272,8 @@ def root_log_likelihood(models: DeviceModels, block_part: jax.Array,
     (`evaluateGenericSpecial.c:897-1001`); here the cross-device sum is the
     segment/jnp sum over the sharded block axis (XLA inserts the collective).
     """
-    lsite = site_likelihoods(models, block_part, clv[p_row], clv[q_row], z)
+    lsite = site_likelihoods(models, block_part, clv[p_row], clv[q_row], z,
+                             site_rates)
     acc = _acc_dtype(lsite.dtype)
     _, _, log_min = scale_constants(acc, scale_exp)
     sc = (scaler[p_row] + scaler[q_row]).astype(acc)
@@ -215,7 +287,7 @@ def root_log_likelihood(models: DeviceModels, block_part: jax.Array,
 def newton_raphson_branch(models: DeviceModels, block_part: jax.Array,
                           weights: jax.Array, st: jax.Array, z0: jax.Array,
                           maxiters0: jax.Array, conv0: jax.Array,
-                          num_slots: int):
+                          num_slots: int, site_rates=None):
     """Branch-length Newton-Raphson to convergence, fully on device.
 
     Replaces the reference's host-driven NR loop with one Allreduce per
@@ -238,7 +310,7 @@ def newton_raphson_branch(models: DeviceModels, block_part: jax.Array,
 
     def derivs(z):
         d1, d2 = nr_derivatives(models, block_part, weights, st,
-                                z.astype(st.dtype), num_slots)
+                                z.astype(st.dtype), num_slots, site_rates)
         return d1.astype(acc), d2.astype(acc)
 
     def cond(s):
@@ -300,21 +372,28 @@ def sumtable(models: DeviceModels, block_part: jax.Array,
 
 def nr_derivatives(models: DeviceModels, block_part: jax.Array,
                    weights: jax.Array, st: jax.Array, z: jax.Array,
-                   num_slots: int):
+                   num_slots: int, site_rates=None):
     """(lnL', lnL'') w.r.t. lz summed over sites, per branch slot [C].
 
-    Reference: `coreGAMMA_FLEX` + derivative Allreduce
-    (`makenewzGenericSpecial.c:523-619, 1241-1248`).
+    Reference: `coreGAMMA_FLEX` / `coreGTRCAT` + derivative Allreduce
+    (`makenewzGenericSpecial.c:394-619, 1241-1248`).
     """
-    d = branch_decay(models, z)                             # [M, R, K]
-    e1 = models.eign[:, None, :] * models.gamma_rates[:, :, None]
     wb = models.rate_weights[block_part]                    # [B, R]
-    db = d[block_part]                                      # [B, R, K]
-    e1b = e1[block_part]
-
-    lsite = einsum("br,blrj,brj->bl", wb, st, db)
-    dsite = einsum("br,blrj,brj,brj->bl", wb, st, db, e1b)
-    d2site = einsum("br,blrj,brj,brj,brj->bl", wb, st, db, e1b, e1b)
+    if site_rates is None:
+        d = branch_decay(models, z)                         # [M, R, K]
+        e1 = models.eign[:, None, :] * models.gamma_rates[:, :, None]
+        db = d[block_part]                                  # [B, R, K]
+        e1b = e1[block_part]
+        lsite = einsum("br,blrj,brj->bl", wb, st, db)
+        dsite = einsum("br,blrj,brj,brj->bl", wb, st, db, e1b)
+        d2site = einsum("br,blrj,brj,brj,brj->bl", wb, st, db, e1b, e1b)
+    else:
+        db = psr_decay(models, block_part, site_rates, z)   # [B, l, R, K]
+        e1b = (models.eign[block_part][:, None, None, :]
+               * site_rates[:, :, :, None])                 # [B, l, R, K]
+        lsite = einsum("br,blrj,blrj->bl", wb, st, db)
+        dsite = einsum("br,blrj,blrj,blrj->bl", wb, st, db, e1b)
+        d2site = einsum("br,blrj,blrj,blrj,blrj->bl", wb, st, db, e1b, e1b)
 
     lsite = jnp.maximum(lsite, jnp.finfo(lsite.dtype).tiny)
     acc = _acc_dtype(lsite.dtype)

@@ -159,8 +159,13 @@ def opt_freqs(inst: PhyloInstance, tree: Tree,
 def mod_opt(inst: PhyloInstance, tree: Tree, likelihood_epsilon: float,
             max_rounds: int = 100, auto_protein_fn=None) -> float:
     """Round-robin parameter optimization until Delta lnL < epsilon
-    (reference `modOpt`, `optimizeModel.c:2963-3133`)."""
+    (reference `modOpt`, `optimizeModel.c:2963-3133`).  Under GAMMA the
+    rate-heterogeneity step is the alpha Brent; under PSR it is a rate
+    categorization round, capped at 3 per search as the reference's
+    `catOpt < 3` (`optimizeModel.c:3100-3110`)."""
     inst.evaluate(tree, full=True)
+    if getattr(inst, "psr", False):
+        inst.cat_opt_rounds = 0
     while max_rounds > 0:
         max_rounds -= 1
         current = inst.likelihood
@@ -170,7 +175,13 @@ def mod_opt(inst: PhyloInstance, tree: Tree, likelihood_epsilon: float,
         tree_evaluate(inst, tree, 0.0625)
         opt_freqs(inst, tree)
         tree_evaluate(inst, tree, 0.0625)
-        opt_alphas(inst, tree)
+        if getattr(inst, "psr", False):
+            if inst.cat_opt_rounds < 3:
+                from examl_tpu.optimize.psr import optimize_rate_categories
+                optimize_rate_categories(inst, tree)
+                inst.cat_opt_rounds += 1
+        else:
+            opt_alphas(inst, tree)
         tree_evaluate(inst, tree, 0.1)
         if abs(current - inst.likelihood) <= likelihood_epsilon:
             break

@@ -1,0 +1,177 @@
+"""PSR (per-site rate / CAT) model optimization.
+
+Reference: `optimizeRateCategories` and its pipeline
+(`optimizeModel.c:1792-2507`): per-site rate hill scan
+(`optRateCatPthreads` via `evaluatePartialGeneric`), master-side
+categorization into <=`-c` categories (`categorizeTheRates` /
+`categorizePartition`), weighted mean-rate-1 normalization
+(`updatePerSiteRates`), and accept-only-if-better semantics.
+
+TPU-native redesign (SURVEY §7.3(5)): instead of one tiny host traversal
+per (site, trial rate), ALL sites' likelihoods under a whole grid of
+candidate rates are computed by a single full traversal per grid chunk
+with a per-site-rate axis (`LikelihoodEngine.rate_scan`).  The candidate
+grid reproduces the reference's hill-scan probes: current rate +- k
+spacings, with the spacing schedule shrinking per invocation.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import numpy as np
+
+from examl_tpu.instance import PhyloInstance
+from examl_tpu.tree.topology import Tree
+
+MIN_RATE = 0.0001          # reference lower bound on trial rates
+RATE_STEPS = 16            # +-k steps of the reference's open-ended scan
+CAT_MERGE_TOL = 0.001      # rates closer than this share a category
+MAX_CAT_ROUNDS = 3         # catOpt < 3 in modOpt (optimizeModel.c:3100)
+
+
+def _spacings(invocations: int) -> tuple[float, float]:
+    """Shrinking scan spacings (reference `optimizeRateCategories`,
+    `optimizeModel.c:2430-2444`)."""
+    n = max(invocations, 1)
+    if n == 1:
+        lower, upper = 0.5, 1.0
+    else:
+        lower, upper = 0.05 / n, 0.1 / n
+    return max(lower, 0.001), max(upper, 0.001)
+
+
+def _scan_partition_rates(inst: PhyloInstance, tree: Tree,
+                          lower: float, upper: float,
+                          grid_chunk: int = 8) -> None:
+    """Update inst.patrat / inst.site_lhs with the best rate per site from
+    the candidate grid (the batched optRateCatPthreads)."""
+    p, entries = tree.full_traversal()
+    offsets = np.concatenate([
+        -lower * np.arange(RATE_STEPS, 0, -1),
+        [0.0],
+        upper * np.arange(1, RATE_STEPS + 1)])
+    G = len(offsets)
+
+    for states, bucket in inst.buckets.items():
+        eng = inst.engines[states]
+        packed_r0 = np.ones(bucket.num_sites)
+        for li, gid in enumerate(bucket.part_ids):
+            packed_r0[bucket.site_indices(li)] = inst.patrat[gid]
+        r0 = packed_r0.reshape(bucket.num_blocks, bucket.lane)
+
+        best_lnl = np.full((bucket.num_blocks, bucket.lane), -np.inf)
+        best_rate = r0.copy()
+        cur_lnl = None
+        for start in range(0, G, grid_chunk):
+            offs = offsets[start:start + grid_chunk]
+            grid = r0[:, :, None] + offs[None, None, :]
+            valid = grid > MIN_RATE
+            grid = np.maximum(grid, MIN_RATE)
+            lnls = eng.rate_scan(entries, p.number, p.back.number, p.z,
+                                 grid)                       # [B, lane, Gc]
+            lnls = np.where(valid, lnls, -np.inf)
+            if 0.0 in offs:
+                cur_lnl = lnls[:, :, list(offs).index(0.0)]
+            c = np.argmax(lnls, axis=2)
+            cl = np.take_along_axis(lnls, c[:, :, None], 2)[:, :, 0]
+            cr = np.take_along_axis(grid, c[:, :, None], 2)[:, :, 0]
+            upd = cl > best_lnl
+            best_lnl = np.where(upd, cl, best_lnl)
+            best_rate = np.where(upd, cr, best_rate)
+        # Keep the current rate unless a probe strictly improved on it
+        # (reference accepts left/right only if > initialLikelihood).
+        keep = best_lnl <= cur_lnl
+        best_rate = np.where(keep, r0, best_rate)
+        best_lnl = np.where(keep, cur_lnl, best_lnl)
+
+        flat_rate = best_rate.reshape(-1)
+        flat_lnl = best_lnl.reshape(-1)
+        for li, gid in enumerate(bucket.part_ids):
+            idx = bucket.site_indices(li)
+            inst.patrat[gid] = flat_rate[idx].copy()
+            inst.site_lhs[gid] = flat_lnl[idx].copy()
+
+
+def _categorize_partition(patrat: np.ndarray, lhs: np.ndarray,
+                          max_categories: int):
+    """Bucket a partition's site rates into <= max_categories categories
+    (reference `categorizeTheRates`/`categorizePartition`): distinct rates
+    (tolerance-merged) ranked by accumulated site lnL, surplus sites
+    snapped to the nearest kept rate.
+
+    Returns (category_per_site [W] int32, category_rates [ncat]).
+    """
+    cat_rates: List[float] = []
+    cat_lnl: List[float] = []
+    for r, l in zip(patrat, lhs):
+        for k, cr in enumerate(cat_rates):
+            if abs(r - cr) < CAT_MERGE_TOL:
+                cat_lnl[k] += l
+                break
+        else:
+            cat_rates.append(float(r))
+            cat_lnl.append(float(l))
+    order = np.argsort(cat_lnl)          # ascending accumulated lnL
+    kept = np.array([cat_rates[i] for i in order[:max_categories]])
+    category = np.argmin(np.abs(patrat[:, None] - kept[None, :]), axis=1)
+    return category.astype(np.int32), kept
+
+
+def _normalize_mean_rate(inst: PhyloInstance) -> None:
+    """Scale category rates so the weighted mean site rate is 1 — per
+    partition under per-partition branch lengths, globally otherwise
+    (reference `updatePerSiteRates`, `optimizeModel.c:2060-2120`)."""
+    parts = inst.alignment.partitions
+    if inst.num_branch_slots > 1:
+        for gid, part in enumerate(parts):
+            rates = inst.per_site_rates[gid][inst.rate_category[gid]]
+            mean = float(part.weights @ rates) / float(part.weights.sum())
+            inst.per_site_rates[gid] = inst.per_site_rates[gid] / mean
+    else:
+        num = den = 0.0
+        for gid, part in enumerate(parts):
+            rates = inst.per_site_rates[gid][inst.rate_category[gid]]
+            num += float(part.weights @ rates)
+            den += float(part.weights.sum())
+        scale = num / den
+        for gid in range(len(parts)):
+            inst.per_site_rates[gid] = inst.per_site_rates[gid] / scale
+    for gid in range(len(parts)):
+        inst.patrat[gid] = inst.per_site_rates[gid][inst.rate_category[gid]]
+
+
+def optimize_rate_categories(inst: PhyloInstance, tree: Tree,
+                             max_categories: int | None = None) -> float:
+    """One CAT optimization round: scan, categorize, normalize, accept if
+    the full lnL improved (reference `optimizeRateCategories`)."""
+    assert inst.psr
+    max_categories = max_categories or inst.psr_categories
+    if max_categories == 1:
+        return inst.evaluate(tree, full=True)
+
+    initial_lnl = inst.evaluate(tree, full=True)
+    backup = ([r.copy() for r in inst.patrat],
+              [c.copy() for c in inst.rate_category],
+              [p.copy() for p in inst.per_site_rates])
+
+    inst.psr_invocations += 1
+    lower, upper = _spacings(inst.psr_invocations)
+    _scan_partition_rates(inst, tree, lower, upper)
+
+    for gid in range(inst.num_parts):
+        cat, kept = _categorize_partition(
+            inst.patrat[gid], inst.site_lhs[gid], max_categories)
+        inst.rate_category[gid] = cat
+        inst.per_site_rates[gid] = kept
+        inst.patrat[gid] = kept[cat]
+    _normalize_mean_rate(inst)
+    inst.push_site_rates()
+
+    lnl = inst.evaluate(tree, full=True)
+    if lnl < initial_lnl:
+        inst.patrat, inst.rate_category, inst.per_site_rates = backup
+        inst.push_site_rates()
+        lnl = inst.evaluate(tree, full=True)
+        assert abs(lnl - initial_lnl) < 1e-6, (lnl, initial_lnl)
+    return lnl

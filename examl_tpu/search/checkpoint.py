@@ -42,18 +42,26 @@ def _fingerprint(inst: PhyloInstance) -> dict:
         "ncat": inst.ncat,
         "use_median": inst.use_median,
         "per_partition_branches": inst.per_partition_branches,
+        "rate_model": getattr(inst, "rate_model", "GAMMA"),
     }
 
 
 def _models_blob(inst: PhyloInstance) -> list:
     out = []
     for gid, m in enumerate(inst.models):
-        out.append({
+        d = {
             "rates": np.asarray(m.rates).tolist(),
             "freqs": np.asarray(m.freqs).tolist(),
             "alpha": float(m.alpha),
             "auto_name": inst.auto_prot_models.get(gid),
-        })
+        }
+        if getattr(inst, "psr", False):
+            # Per-site rate state (reference gathers the distributed CAT
+            # arrays before writing, `searchAlgo.c:1122-1146`; ours are
+            # host-resident per partition already).
+            d["rate_category"] = inst.rate_category[gid].tolist()
+            d["per_site_rates"] = inst.per_site_rates[gid].tolist()
+        out.append(d)
     return out
 
 
@@ -66,7 +74,15 @@ def _restore_models(inst: PhyloInstance, blob: list) -> None:
             part.datatype, np.asarray(d["freqs"]),
             rates=np.asarray(d["rates"]), alpha=d["alpha"],
             ncat=inst.ncat, use_median=inst.use_median)
+        if getattr(inst, "psr", False) and "rate_category" in d:
+            inst.rate_category[gid] = np.asarray(d["rate_category"],
+                                                 dtype=np.int32)
+            inst.per_site_rates[gid] = np.asarray(d["per_site_rates"])
+            inst.patrat[gid] = inst.per_site_rates[gid][
+                inst.rate_category[gid]]
     inst.push_models()
+    if getattr(inst, "psr", False):
+        inst.push_site_rates()
 
 
 class CheckpointManager:

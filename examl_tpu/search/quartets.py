@@ -149,6 +149,11 @@ def compute_quartets(inst: PhyloInstance, tree: Tree, opts: QuartetOptions,
         blob = opts.resume
         start_counter = int(blob["extras"]["quartet_counter"])
         pos = int(blob["extras"]["file_position"])
+        if not os.path.exists(out_path):
+            raise ValueError(
+                f"quartet checkpoint found but its output file {out_path} "
+                "is missing; the checkpoint records a resume position in "
+                "that file, so restart fresh (without -R) instead")
         with open(out_path, "r+") as f:
             f.truncate(pos)
         log(f"resuming quartets at set {start_counter}")
@@ -176,6 +181,7 @@ def compute_quartets(inst: PhyloInstance, tree: Tree, opts: QuartetOptions,
         for t1, t2, t3, t4 in _quartet_sets(inst, opts):
             if counter >= start_counter:
                 if (opts.checkpoint_mgr is not None
+                        and counter != start_counter
                         and counter % opts.checkpoint_interval == 0):
                     f.flush()
                     opts.checkpoint_mgr.write(

@@ -27,13 +27,19 @@ def generator(model: ModelParams) -> np.ndarray:
 
 
 def oracle_lnl(tree: Tree, alignment: AlignmentData,
-               models: list[ModelParams], p: Node | None = None) -> float:
-    """Total lnL at branch (p, p.back) via plain pruning."""
+               models: list[ModelParams], p: Node | None = None,
+               site_rates: list[np.ndarray] | None = None) -> float:
+    """Total lnL at branch (p, p.back) via plain pruning.
+
+    site_rates: optional per-partition [W] per-site rate multipliers (the
+    PSR model); when given, each site is evaluated under its own rate and
+    the model's gamma categories are ignored.
+    """
     if p is None:
         p = tree.start
     q = p.back
     total = 0.0
-    for part, model in zip(alignment.partitions, models):
+    for gid, (part, model) in enumerate(zip(alignment.partitions, models)):
         table = part.datatype.tip_indicator_table()
         Q = generator(model)
         codes = part.patterns          # [ntaxa, W]
@@ -50,12 +56,18 @@ def oracle_lnl(tree: Tree, alignment: AlignmentData,
                 out *= down(s.back, rate) @ P.T
             return out
 
-        site_l = np.zeros(W)
-        for rate in model.gamma_rates:
+        def root_site_l(rate: float) -> np.ndarray:
             t = -np.log(p.z[0])
             P = expm(Q * rate * t)
-            vp = down(p, rate)
-            vq = down(q, rate)
-            site_l += (vp * (vq @ P.T)) @ model.freqs / model.ncat
+            return (down(p, rate) * (down(q, rate) @ P.T)) @ model.freqs
+
+        site_l = np.zeros(W)
+        if site_rates is not None:
+            for rate in np.unique(site_rates[gid]):
+                sel = site_rates[gid] == rate
+                site_l[sel] = root_site_l(float(rate))[sel]
+        else:
+            for rate in model.gamma_rates:
+                site_l += root_site_l(float(rate)) / model.ncat
         total += float(part.weights @ np.log(site_l))
     return total

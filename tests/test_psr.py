@@ -1,0 +1,137 @@
+"""PSR (per-site rate / CAT) model: kernel parity vs the oracle, the
+batched rate scan, categorization, and the optimization round."""
+
+import numpy as np
+import pytest
+
+from examl_tpu.instance import PhyloInstance
+from examl_tpu.io.alignment import build_alignment_data, load_alignment
+from examl_tpu.optimize.psr import (_categorize_partition,
+                                    optimize_rate_categories)
+
+from tests.conftest import TESTDATA
+from tests.oracle import oracle_lnl
+
+
+def _dna(ntaxa=10, nsites=240, seed=7):
+    rng = np.random.default_rng(seed)
+    cur = rng.integers(0, 4, nsites)
+    seqs = []
+    for _ in range(ntaxa):
+        flip = rng.random(nsites) < 0.2
+        cur = np.where(flip, rng.integers(0, 4, nsites), cur)
+        seqs.append("".join("ACGT"[c] for c in cur))
+    return build_alignment_data([f"t{i}" for i in range(ntaxa)], seqs)
+
+
+@pytest.fixture(scope="module")
+def psr_inst():
+    return PhyloInstance(_dna(), rate_model="PSR")
+
+
+def test_psr_lnl_matches_oracle(psr_inst):
+    """PSR engine with non-uniform per-site rates == oracle pruning."""
+    inst = psr_inst
+    tree = inst.random_tree(seed=3)
+    rng = np.random.default_rng(0)
+    # Assign 5 distinct category rates across sites, mean-normalized.
+    W = inst.alignment.partitions[0].width
+    cats = rng.integers(0, 5, W)
+    rates = np.array([0.1, 0.5, 1.0, 2.0, 4.0])[cats]
+    w = inst.alignment.partitions[0].weights
+    rates = rates / (float(w @ rates) / float(w.sum()))
+    inst.patrat[0] = rates
+    inst.push_site_rates()
+
+    lnl = inst.evaluate(tree, full=True)
+    ref = oracle_lnl(tree, inst.alignment, inst.models,
+                     site_rates=[rates])
+    assert lnl == pytest.approx(ref, rel=1e-9)
+    # And uniform rates reproduce the single-rate model.
+    inst.patrat[0] = np.ones(W)
+    inst.push_site_rates()
+    lnl1 = inst.evaluate(tree, full=True)
+    ref1 = oracle_lnl(tree, inst.alignment, inst.models,
+                      site_rates=[np.ones(W)])
+    assert lnl1 == pytest.approx(ref1, rel=1e-9)
+
+
+def test_psr_branch_optimization_improves(psr_inst):
+    inst = psr_inst
+    tree = inst.random_tree(seed=5)
+    lnl0 = inst.evaluate(tree, full=True)
+    from examl_tpu.optimize.branch import tree_evaluate
+    lnl1 = tree_evaluate(inst, tree, 1.0)
+    assert lnl1 > lnl0
+
+
+def test_rate_scan_matches_direct_evaluation(psr_inst):
+    """The batched grid scan's per-site lnls agree with installing each
+    candidate rate and evaluating."""
+    inst = psr_inst
+    tree = inst.random_tree(seed=2)
+    inst.evaluate(tree, full=True)
+    (eng,) = inst.engines.values()
+    bucket = inst.buckets[4]
+    p, entries = tree.full_traversal()
+    W = inst.alignment.partitions[0].width
+    w = inst.alignment.partitions[0].weights
+
+    r_lo = np.full((bucket.num_blocks, bucket.lane, 1), 0.5)
+    r_hi = np.full((bucket.num_blocks, bucket.lane, 1), 2.0)
+    grid = np.concatenate([r_lo, r_hi], axis=2)
+    lnls = eng.rate_scan(entries, p.number, p.back.number, p.z, grid)
+
+    for g, rate in enumerate((0.5, 2.0)):
+        ref = oracle_lnl(tree, inst.alignment, inst.models,
+                         site_rates=[np.full(W, rate)])
+        got = float(w @ lnls.reshape(-1, 2)[bucket.site_indices(0), g])
+        assert got == pytest.approx(ref, rel=1e-9)
+
+
+def test_categorize_partition_caps_and_snaps():
+    patrat = np.array([0.1, 0.1001, 1.0, 2.0, 2.0005, 3.0, 4.0])
+    lhs = np.array([-5.0, -5.0, -100.0, -50.0, -50.0, -20.0, -1.0])
+    cat, kept = _categorize_partition(patrat, lhs, max_categories=3)
+    assert len(kept) == 3
+    assert len(np.unique(cat)) <= 3
+    # 1.0 (most negative accumulated lnL) must be kept.
+    assert np.any(np.isclose(kept, 1.0))
+    # All sites snap to their nearest kept rate.
+    for r, c in zip(patrat, cat):
+        assert abs(r - kept[c]) == np.min(np.abs(r - kept))
+
+
+@pytest.mark.slow
+def test_psr_optimization_round_improves_and_normalizes():
+    inst = PhyloInstance(_dna(seed=11), rate_model="PSR")
+    tree = inst.random_tree(seed=1)
+    from examl_tpu.optimize.branch import tree_evaluate
+    tree_evaluate(inst, tree, 1.0)
+    lnl0 = inst.evaluate(tree, full=True)
+    lnl1 = optimize_rate_categories(inst, tree, max_categories=25)
+    assert lnl1 >= lnl0 - 1e-9
+    assert len(inst.per_site_rates[0]) <= 25
+    # Weighted mean rate == 1 after normalization.
+    part = inst.alignment.partitions[0]
+    mean = float(part.weights @ inst.patrat[0]) / float(part.weights.sum())
+    assert mean == pytest.approx(1.0, abs=1e-9)
+    # A second round with tighter spacing keeps improving or holds.
+    lnl2 = optimize_rate_categories(inst, tree, max_categories=25)
+    assert lnl2 >= lnl1 - 1e-9
+
+
+@pytest.mark.slow
+def test_psr_mod_opt_on_49(psr49=None):
+    """modOpt under PSR on the 49-taxon fixture improves lnL and caps
+    categories at the default 25."""
+    data = load_alignment(f"{TESTDATA}/49", f"{TESTDATA}/49.model")
+    inst = PhyloInstance(data, rate_model="PSR")
+    with open(f"{TESTDATA}/49.tree") as f:
+        tree = inst.tree_from_newick(f.read())
+    lnl0 = inst.evaluate(tree, full=True)
+    from examl_tpu.optimize.model_opt import mod_opt
+    lnl = mod_opt(inst, tree, 5.0, max_rounds=2)
+    assert lnl > lnl0
+    for gid in range(inst.num_parts):
+        assert len(inst.per_site_rates[gid]) <= 25
