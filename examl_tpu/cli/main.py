@@ -162,6 +162,7 @@ def run_search(args, inst, files: RunFiles) -> int:
 
     mgr = CheckpointManager(args.workdir, args.run_id)
     resume = None
+    constraint = None
     if args.restart:
         tree = inst.random_tree(seed=args.seed)     # overwritten by restore
         resume = mgr.restore(inst, tree)
@@ -170,10 +171,29 @@ def run_search(args, inst, files: RunFiles) -> int:
             return 1
         files.info(f"restart from state {resume['state']} with likelihood "
                    f"{inst.likelihood:.6f}")
+        if args.constraint_file:
+            # Keep enforcing the constraint after the restart (the
+            # restored tree already honors it; only the checker is
+            # rebuilt — the random resolution is NOT redone).
+            from examl_tpu.tree.constraint import load_constraint
+            with open(args.constraint_file) as f:
+                _, constraint = load_constraint(
+                    f.read(), inst.alignment.taxon_names, args.seed,
+                    inst.num_branch_slots)
+            constraint._tree = tree
+    elif args.constraint_file:
+        from examl_tpu.tree.constraint import load_constraint
+        with open(args.constraint_file) as f:
+            tree, constraint = load_constraint(
+                f.read(), inst.alignment.taxon_names, args.seed,
+                inst.num_branch_slots)
+        inst.evaluate(tree, full=True)
+        files.info(f"constraint tree randomly resolved (seed {args.seed}), "
+                   f"lnL {inst.likelihood:.6f}")
     else:
         if not args.tree_file:
-            files.info("a starting tree (-t) or -R is required for the "
-                       "tree search")
+            files.info("a starting tree (-t), a constraint tree (-g), or "
+                       "-R is required for the tree search")
             return 1
         tree = inst.tree_from_newick(_read_trees(args.tree_file)[0])
         inst.evaluate(tree, full=True)
@@ -188,6 +208,7 @@ def run_search(args, inst, files: RunFiles) -> int:
         initial=args.initial if args.initial is not None else 10,
         initial_set=args.initial is not None,
         save_best_trees=args.save_best,
+        constraint=constraint,
         do_cutoff=args.mode != "o",
         search_convergence=args.rf_convergence,
         log=log)
@@ -260,6 +281,7 @@ def main(argv=None) -> int:
         per_partition_branches=args.per_partition_bl,
         rate_model=args.model, psr_categories=args.categories,
         save_memory=args.save_memory)
+    inst.auto_prot_criterion = args.auto_prot
 
     if args.mode in ("d", "o"):
         return run_search(args, inst, files)

@@ -54,9 +54,27 @@ class PhyloInstance:
         # autoProtein selection replaces them during modOpt.
         self.auto_prot_models: Dict[int, str] = {
             gid: "WAG" for gid, p in enumerate(alignment.partitions) if p.auto}
+        self.auto_prot_freqs: Dict[int, str] = {
+            gid: "fixed" for gid in self.auto_prot_models}
         for gid, part in enumerate(alignment.partitions):
-            rates, freqs = None, part.empirical_freqs
             name = self.auto_prot_models.get(gid, part.model_name)
+            if part.lg4:
+                from examl_tpu.models.lg4 import build_lg4
+                if self.psr:
+                    raise ValueError(
+                        "LG4 models are not supported under PSR "
+                        "(the reference likewise restricts LG4 to GAMMA)")
+                if ncat != 4:
+                    raise ValueError("LG4 models require 4 rate categories")
+                if part.optimize_freqs or part.use_empirical_freqs:
+                    raise ValueError(
+                        f"partition {part.name}: LG4 models carry one "
+                        "frequency vector per rate category; the F/X "
+                        "frequency suffixes are not applicable")
+                self.models.append(build_lg4(name, alpha=1.0,
+                                             use_median=use_median))
+                continue
+            rates, freqs = None, part.empirical_freqs
             if part.datatype.name == "AA" and name != "GTR":
                 rates, model_freqs = protein_mod.get_matrix(name)
                 if not part.use_empirical_freqs and not part.optimize_freqs:

@@ -33,19 +33,34 @@ from examl_tpu.utils import z_slots as _z_slots
 def stack_models(models: Sequence[ModelParams],
                  branch_indices: Sequence[int], dtype,
                  psr: bool = False) -> DeviceModels:
+    from examl_tpu.models.lg4 import LG4Params
+
     R = models[0].ncat
     assert all(m.ncat == R for m in models)
     arr = lambda xs: jnp.asarray(np.stack(xs), dtype=dtype)
-    # PSR: one "category" per site with weight 1 (the site's own rate);
-    # GAMMA: R equiprobable categories.
-    weight = 1.0 if psr else 1.0 / R
+
+    def per_cat(m, field_lg4, field):
+        """[R, ...] per-category tensor: LG4 models supply one per
+        category, plain models tile their single one."""
+        if isinstance(m, LG4Params):
+            return np.stack(getattr(m, field_lg4))
+        return np.broadcast_to(getattr(m, field),
+                               (R,) + getattr(m, field).shape)
+
+    def weights_of(m):
+        if psr:
+            return np.ones(R)
+        if isinstance(m, LG4Params):
+            return np.asarray(m.rate_weights)
+        return np.full(R, 1.0 / R)
+
     return DeviceModels(
-        eign=arr([m.eign for m in models]),
-        ev=arr([m.ev for m in models]),
-        ei=arr([m.ei for m in models]),
-        freqs=arr([m.freqs for m in models]),
+        eign=arr([per_cat(m, "eign_list", "eign") for m in models]),
+        ev=arr([per_cat(m, "ev_list", "ev") for m in models]),
+        ei=arr([per_cat(m, "ei_list", "ei") for m in models]),
+        freqs=arr([per_cat(m, "freqs_list", "freqs") for m in models]),
         gamma_rates=arr([m.gamma_rates for m in models]),
-        rate_weights=arr([np.full(R, weight) for m in models]),
+        rate_weights=arr([weights_of(m) for m in models]),
         part_branch=jnp.asarray(np.asarray(branch_indices, dtype=np.int32)),
     )
 

@@ -47,11 +47,16 @@ def _acc_dtype(dtype) -> jnp.dtype:
 
 
 class DeviceModels(NamedTuple):
-    """Stacked per-partition model tensors for one state-count bucket."""
-    eign: jax.Array         # [M, K]  negated eigenvalues, [:,0] == 0
-    ev: jax.Array           # [M, K, K] right eigenvectors (columns of P decomp)
-    ei: jax.Array           # [M, K, K] left eigenvectors (rows)
-    freqs: jax.Array        # [M, K]
+    """Stacked per-partition model tensors for one state-count bucket.
+
+    Eigensystems and frequencies carry a rate-category axis so LG4M/LG4X
+    (one matrix per category, reference `makeP_FlexLG4`) and plain models
+    (identical slices across R) share one kernel set.
+    """
+    eign: jax.Array         # [M, R, K]  negated eigenvalues, [...,0] == 0
+    ev: jax.Array           # [M, R, K, K] right eigenvectors (columns)
+    ei: jax.Array           # [M, R, K, K] left eigenvectors (rows)
+    freqs: jax.Array        # [M, R, K]
     gamma_rates: jax.Array  # [M, R]
     rate_weights: jax.Array  # [M, R] category weights (1/R for GAMMA)
     part_branch: jax.Array  # [M] int32: branch slot per partition (0 if linked)
@@ -97,22 +102,23 @@ def scale_constants(dtype, scale_exp: int):
 
 
 def branch_decay(models: DeviceModels, z: jax.Array) -> jax.Array:
-    """d[m, r, j] = exp(eign_j * rate_r * log z_m), the eigenvalue decay terms.
+    """d[m, r, j] = exp(eign_rj * rate_r * log z_m), the eigenvalue decay.
 
     z: [C] per-branch-slot values; each partition selects its slot.
-    Mirrors reference `makeP` (`newviewGenericSpecial.c:78-168`).
+    Mirrors reference `makeP`/`makeP_FlexLG4`
+    (`newviewGenericSpecial.c:78-206`).
     """
     zm = z[models.part_branch]                              # [M]
     lz = jnp.log(zm)
-    return jnp.exp(models.eign[:, None, :]
+    return jnp.exp(models.eign
                    * models.gamma_rates[:, :, None]
                    * lz[:, None, None])                     # [M, R, K]
 
 
 def p_matrices(models: DeviceModels, z: jax.Array) -> jax.Array:
-    """P[m, r, a, k] = sum_j ev[a,j] d[j] ei[j,k] — dense per-partition P."""
+    """P[m, r, a, k] = sum_j ev[r,a,j] d[r,j] ei[r,j,k] per partition."""
     d = branch_decay(models, z)
-    return einsum("maj,mrj,mjk->mrak", models.ev, d, models.ei)
+    return einsum("mraj,mrj,mrjk->mrak", models.ev, d, models.ei)
 
 
 def apply_p(pmat: jax.Array, block_part: jax.Array, x: jax.Array) -> jax.Array:
@@ -124,7 +130,7 @@ def apply_p(pmat: jax.Array, block_part: jax.Array, x: jax.Array) -> jax.Array:
 def p_matrices_wave(models: DeviceModels, z: jax.Array) -> jax.Array:
     """P[w, m, r, a, k] for one wave of branch vectors z [W, C]."""
     d = jax.vmap(lambda zz: branch_decay(models, zz))(z)    # [W, M, R, K]
-    return einsum("maj,wmrj,mjk->wmrak", models.ev, d, models.ei)
+    return einsum("mraj,wmrj,mrjk->wmrak", models.ev, d, models.ei)
 
 
 def psr_decay(models: DeviceModels, block_part: jax.Array,
@@ -141,7 +147,8 @@ def psr_decay(models: DeviceModels, block_part: jax.Array,
     """
     zb = z[models.part_branch][block_part]                  # [B]
     lz = jnp.log(zb)
-    eb = models.eign[block_part]                            # [B, K]
+    # PSR models are single-category; use the category-0 eigensystem.
+    eb = models.eign[block_part][:, 0, :]                   # [B, K]
     return jnp.exp(eb[:, None, None, :]
                    * site_rates[:, :, :, None]
                    * lz[:, None, None, None])               # [B, lane, R, K]
@@ -154,8 +161,8 @@ def apply_p_factorized(models: DeviceModels, block_part: jax.Array,
     Equivalent to applying P(z, r_site) without building per-site P
     matrices; the two contractions are MXU matmuls over the state axis.
     """
-    eib = models.ei[block_part]                             # [B, K, K]
-    evb = models.ev[block_part]
+    eib = models.ei[block_part][:, 0]                       # [B, K, K] (PSR)
+    evb = models.ev[block_part][:, 0]
     u = einsum("bjk,...blrk->...blrj", eib, x)
     u = u * d
     return einsum("baj,...blrj->...blra", evb, u)
@@ -235,9 +242,9 @@ def site_likelihoods(models: DeviceModels, block_part: jax.Array,
     else:
         d = psr_decay(models, block_part, site_rates, z)
         y = apply_p_factorized(models, block_part, d, xq)
-    fb = models.freqs[block_part]                           # [B, K]
+    fb = models.freqs[block_part]                           # [B, R, K]
     wb = models.rate_weights[block_part]                    # [B, R]
-    return einsum("bk,br,blrk,blrk->bl", fb, wb, xp, y)
+    return einsum("brk,br,blrk,blrk->bl", fb, wb, xp, y)
 
 
 def per_rate_site_lnls(models: DeviceModels, block_part: jax.Array,
@@ -252,7 +259,7 @@ def per_rate_site_lnls(models: DeviceModels, block_part: jax.Array,
     """
     d = psr_decay(models, block_part, site_rates, z)
     y = apply_p_factorized(models, block_part, d, clv[q_row])
-    fb = models.freqs[block_part]
+    fb = models.freqs[block_part][:, 0]                     # [B, K] (PSR)
     lsite = einsum("bk,blrk,blrk->blr", fb, clv[p_row], y)  # [B, lane, R]
     acc = _acc_dtype(lsite.dtype)
     _, _, log_min = scale_constants(acc, scale_exp)
@@ -355,18 +362,18 @@ def newton_raphson_branch(models: DeviceModels, block_part: jax.Array,
 
 def sumtable(models: DeviceModels, block_part: jax.Array,
              xp: jax.Array, xq: jax.Array) -> jax.Array:
-    """st[b,l,r,j] = (sum_k f_k xp_k ev[k,j]) * (sum_k ei[j,k] xq_k).
+    """st[b,l,r,j] = (sum_k f_rk xp_k ev_r[k,j]) * (sum_k ei_r[j,k] xq_k).
 
-    With this table L(lz) = sum_j st_j exp(eign_j r lz) per site, so branch
-    derivatives w.r.t. lz = log z are cheap per NR iteration.
+    With this table L(lz) = sum_j st_j exp(eign_rj rate_r lz) per site, so
+    branch derivatives w.r.t. lz = log z are cheap per NR iteration.
     Reference: `makenewzIterative` sum kernels
     (`makenewzGenericSpecial.c:251-326`).
     """
-    evb = models.ev[block_part]                             # [B, K, K]
+    evb = models.ev[block_part]                             # [B, R, K, K]
     eib = models.ei[block_part]
-    fb = models.freqs[block_part]
-    ap = einsum("bk,blrk,bkj->blrj", fb, xp, evb)
-    bq = einsum("bjk,blrk->blrj", eib, xq)
+    fb = models.freqs[block_part]                           # [B, R, K]
+    ap = einsum("brk,blrk,brkj->blrj", fb, xp, evb)
+    bq = einsum("brjk,blrk->blrj", eib, xq)
     return ap * bq
 
 
@@ -381,7 +388,7 @@ def nr_derivatives(models: DeviceModels, block_part: jax.Array,
     wb = models.rate_weights[block_part]                    # [B, R]
     if site_rates is None:
         d = branch_decay(models, z)                         # [M, R, K]
-        e1 = models.eign[:, None, :] * models.gamma_rates[:, :, None]
+        e1 = models.eign * models.gamma_rates[:, :, None]   # [M, R, K]
         db = d[block_part]                                  # [B, R, K]
         e1b = e1[block_part]
         lsite = einsum("br,blrj,brj->bl", wb, st, db)
@@ -389,7 +396,7 @@ def nr_derivatives(models: DeviceModels, block_part: jax.Array,
         d2site = einsum("br,blrj,brj,brj,brj->bl", wb, st, db, e1b, e1b)
     else:
         db = psr_decay(models, block_part, site_rates, z)   # [B, l, R, K]
-        e1b = (models.eign[block_part][:, None, None, :]
+        e1b = (models.eign[block_part][:, 0][:, None, None, :]
                * site_rates[:, :, :, None])                 # [B, l, R, K]
         lsite = einsum("br,blrj,blrj->bl", wb, st, db)
         dsite = einsum("br,blrj,blrj,blrj->bl", wb, st, db, e1b)

@@ -119,15 +119,76 @@ def opt_rates(inst: PhyloInstance, tree: Tree,
 
 def opt_alphas(inst: PhyloInstance, tree: Tree,
                tol: float = MODEL_EPSILON) -> None:
-    groups = [[gid] for gid in range(inst.num_parts)]
+    """Gamma-shape Brent for every partition except LG4X (whose category
+    rates are free parameters optimized by opt_lg4x instead)."""
+    from examl_tpu.models.lg4 import LG4Params, lg4_with_alpha
+
+    groups = [[gid] for gid in range(inst.num_parts)
+              if not (isinstance(inst.models[gid], LG4Params)
+                      and inst.models[gid].is_lg4x)]
+    if not groups:
+        return
 
     def get0(gid):
         return float(inst.models[gid].alpha)
 
     def setv(gid, v):
-        inst.models[gid] = with_alpha(inst.models[gid], v)
+        m = inst.models[gid]
+        inst.models[gid] = (lg4_with_alpha(m, v)
+                            if isinstance(m, LG4Params) else with_alpha(m, v))
 
     _opt_param(inst, tree, groups, get0, setv, ALPHA_MIN, ALPHA_MAX, tol)
+
+
+def opt_lg4x(inst: PhyloInstance, tree: Tree,
+             tol: float = MODEL_EPSILON) -> None:
+    """LG4X free category rates + weights (reference `optLG4X` +
+    `optimizeWeights`, `optimizeModel.c:1114-1132`): per round, Brent each
+    of the 4 rates then each of the 4 weight exponents."""
+    from examl_tpu.models.lg4 import (LG4X_RATE_MAX, LG4X_RATE_MIN,
+                                      LG4Params, lg4x_with_rates,
+                                      lg4x_with_weights)
+
+    gids = [gid for gid in range(inst.num_parts)
+            if isinstance(inst.models[gid], LG4Params)
+            and inst.models[gid].is_lg4x]
+    if not gids:
+        return
+    groups = [[g] for g in gids]
+
+    # Trial rate vectors derive from a per-k base snapshot, not from the
+    # trial-mutated model: normalization rescales all four rates, so the
+    # objective must be a pure function of the Brent variable and the
+    # reject-restore (setv(v0)) must reproduce the base exactly.
+    for k in range(4):
+        base = {g: np.asarray(inst.models[g].gamma_rates).copy()
+                for g in gids}
+
+        def get0(gid, k=k):
+            return float(base[gid][k])
+
+        def setv(gid, v, k=k):
+            rates = base[gid].copy()
+            rates[k] = v
+            inst.models[gid] = lg4x_with_rates(inst.models[gid], rates)
+
+        _opt_param(inst, tree, groups, get0, setv, LG4X_RATE_MIN,
+                   LG4X_RATE_MAX, tol, only_states={20}, coherent=k > 0)
+
+    exponents = {g: np.log(np.maximum(inst.models[g].rate_weights, 1e-12))
+                 for g in gids}
+    for k in range(4):
+        def get0(gid, k=k):
+            return float(exponents[gid][k])
+
+        def setv(gid, v, k=k):
+            exponents[gid][k] = v
+            e = exponents[gid] - exponents[gid].max()
+            inst.models[gid] = lg4x_with_weights(inst.models[gid],
+                                                 np.exp(e))
+
+        _opt_param(inst, tree, groups, get0, setv, FREQ_EXP_MIN,
+                   FREQ_EXP_MAX, tol, only_states={20}, coherent=True)
 
 
 def opt_freqs(inst: PhyloInstance, tree: Tree,
@@ -166,6 +227,14 @@ def mod_opt(inst: PhyloInstance, tree: Tree, likelihood_epsilon: float,
     inst.evaluate(tree, full=True)
     if getattr(inst, "psr", False):
         inst.cat_opt_rounds = 0
+    if auto_protein_fn is None and any(
+            p.auto for p in inst.alignment.partitions):
+        from functools import partial
+
+        from examl_tpu.optimize.auto_protein import auto_protein
+        auto_protein_fn = partial(
+            auto_protein,
+            criterion=getattr(inst, "auto_prot_criterion", "ml"))
     while max_rounds > 0:
         max_rounds -= 1
         current = inst.likelihood
@@ -182,6 +251,7 @@ def mod_opt(inst: PhyloInstance, tree: Tree, likelihood_epsilon: float,
                 inst.cat_opt_rounds += 1
         else:
             opt_alphas(inst, tree)
+            opt_lg4x(inst, tree)
         tree_evaluate(inst, tree, 0.1)
         if abs(current - inst.likelihood) <= likelihood_epsilon:
             break

@@ -47,13 +47,25 @@ def _fingerprint(inst: PhyloInstance) -> dict:
 
 
 def _models_blob(inst: PhyloInstance) -> list:
+    from examl_tpu.models.lg4 import LG4Params
+
     out = []
     for gid, m in enumerate(inst.models):
+        if isinstance(m, LG4Params):
+            d = {
+                "lg4": m.name,
+                "alpha": float(m.alpha),
+                "gamma_rates": np.asarray(m.gamma_rates).tolist(),
+                "rate_weights": np.asarray(m.rate_weights).tolist(),
+            }
+            out.append(d)
+            continue
         d = {
             "rates": np.asarray(m.rates).tolist(),
             "freqs": np.asarray(m.freqs).tolist(),
             "alpha": float(m.alpha),
             "auto_name": inst.auto_prot_models.get(gid),
+            "auto_freqs": inst.auto_prot_freqs.get(gid),
         }
         if getattr(inst, "psr", False):
             # Per-site rate state (reference gathers the distributed CAT
@@ -66,10 +78,23 @@ def _models_blob(inst: PhyloInstance) -> list:
 
 
 def _restore_models(inst: PhyloInstance, blob: list) -> None:
+    from dataclasses import replace as dc_replace
+
+    from examl_tpu.models.lg4 import build_lg4
+
     for gid, d in enumerate(blob):
         part = inst.alignment.partitions[gid]
+        if d.get("lg4"):
+            m = build_lg4(d["lg4"], alpha=d["alpha"],
+                          use_median=inst.use_median)
+            inst.models[gid] = dc_replace(
+                m, gamma_rates=np.asarray(d["gamma_rates"]),
+                rate_weights=np.asarray(d["rate_weights"]))
+            continue
         if d.get("auto_name"):
             inst.auto_prot_models[gid] = d["auto_name"]
+        if d.get("auto_freqs"):
+            inst.auto_prot_freqs[gid] = d["auto_freqs"]
         inst.models[gid] = build_model(
             part.datatype, np.asarray(d["freqs"]),
             rates=np.asarray(d["rates"]), alpha=d["alpha"],

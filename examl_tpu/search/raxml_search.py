@@ -33,6 +33,7 @@ class SearchOptions:
     max_rearrange: int = 21            # slow-SPR radius ceiling
     stepwidth: int = 5                 # slow-SPR radius increment
     save_best_trees: int = 0           # -B
+    constraint: object = None          # TreeConstraint (-g)
     estimate_model: bool = True
     do_cutoff: bool = True             # lnL cutoff heuristic (no -f o flag)
     big_cutoff: bool = False
@@ -177,6 +178,7 @@ def compute_big_rapid(inst: PhyloInstance, tree: Tree,
     res = SearchResult()
     ctx = SprContext(inst, do_cutoff=opts.do_cutoff,
                      big_cutoff=opts.big_cutoff)
+    ctx.constraint = opts.constraint
     best_t = BestList(1)
     bt = BestList(20)
     best_ml = BestList(opts.save_best_trees) if opts.save_best_trees else None

@@ -56,8 +56,10 @@ class SprContext:
         self.lh_avg = 0.0
         self.lh_dec = 0
         self.it_count = 0
-        # Constraint checking hook (set when a constraint tree is loaded).
+        # Constraint checking hook (set when a constraint tree is loaded)
+        # + the pruned subtree's cluster set, cached per prune.
         self.constraint = None
+        self.pruned_clusters = None
 
 
 from examl_tpu.utils import z_slots
@@ -79,6 +81,8 @@ def remove_node(inst: PhyloInstance, tree: Tree, ctx: SprContext,
     hookup(q, r, result.tolist())
     p.next.back = None
     p.next.next.back = None
+    if ctx.constraint is not None:
+        ctx.pruned_clusters = ctx.constraint.clusters_behind(p.back)
     return q
 
 
@@ -179,7 +183,8 @@ def test_insert(inst: PhyloInstance, tree: Tree, ctx: SprContext,
     qz = list(q.z)
     pz = list(p.z)
 
-    if ctx.constraint is not None and not ctx.constraint.insertion_ok(p, q):
+    if ctx.constraint is not None and not ctx.constraint.insertion_ok(
+            p, q, ctx.pruned_clusters):
         return True
 
     insert_node(inst, tree, ctx, p, q)

@@ -240,6 +240,13 @@ class Tree:
         entries = self.compute_traversal(p, full=True)
         return p, entries
 
+    def reset_branches(self) -> None:
+        """Set every branch back to the default length (reference
+        `resetBranches`, `optimizeModel.c:2510-2530`)."""
+        for p, _ in self.all_branches():
+            p.z[:] = [DEFAULTZ] * len(p.z)
+        self.invalidate_all()
+
     def invalidate_all(self) -> None:
         for num in range(self.ntips + 1, self._next_inner):
             for slot in self.slots(num):

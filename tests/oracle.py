@@ -17,12 +17,17 @@ from examl_tpu.models.gtr import ModelParams, rates_to_matrix
 from examl_tpu.tree.topology import Node, Tree
 
 
-def generator(model: ModelParams) -> np.ndarray:
-    R = rates_to_matrix(model.rates, model.states)
-    Q = R * model.freqs[None, :]
+def generator(model: ModelParams, cat: int | None = None) -> np.ndarray:
+    from examl_tpu.models.lg4 import LG4Params
+    if isinstance(model, LG4Params) and cat is not None:
+        rates, freqs = model.rates_list[cat], model.freqs_list[cat]
+    else:
+        rates, freqs = model.rates, model.freqs
+    R = rates_to_matrix(rates, model.states)
+    Q = R * freqs[None, :]
     np.fill_diagonal(Q, 0.0)
     np.fill_diagonal(Q, -Q.sum(axis=1))
-    fracchange = model.freqs @ R @ model.freqs
+    fracchange = freqs @ R @ freqs
     return Q / fracchange
 
 
@@ -39,13 +44,14 @@ def oracle_lnl(tree: Tree, alignment: AlignmentData,
         p = tree.start
     q = p.back
     total = 0.0
+    from examl_tpu.models.lg4 import LG4Params
     for gid, (part, model) in enumerate(zip(alignment.partitions, models)):
         table = part.datatype.tip_indicator_table()
-        Q = generator(model)
         codes = part.patterns          # [ntaxa, W]
         W = codes.shape[1]
+        is_lg4 = isinstance(model, LG4Params)
 
-        def down(slot: Node, rate: float) -> np.ndarray:
+        def down(slot: Node, rate: float, Q) -> np.ndarray:
             """[W, states] conditional likelihood of subtree behind slot."""
             if tree.is_tip(slot.number):
                 return table[codes[slot.number - 1]]
@@ -53,19 +59,26 @@ def oracle_lnl(tree: Tree, alignment: AlignmentData,
             for s in (slot.next, slot.next.next):
                 t = -np.log(s.z[0])
                 P = expm(Q * rate * t)
-                out *= down(s.back, rate) @ P.T
+                out *= down(s.back, rate, Q) @ P.T
             return out
 
-        def root_site_l(rate: float) -> np.ndarray:
+        def root_site_l(rate: float, cat=None) -> np.ndarray:
+            Q = generator(model, cat)
+            freqs = model.freqs_list[cat] if (is_lg4 and cat is not None) \
+                else model.freqs
             t = -np.log(p.z[0])
             P = expm(Q * rate * t)
-            return (down(p, rate) * (down(q, rate) @ P.T)) @ model.freqs
+            return (down(p, rate, Q) * (down(q, rate, Q) @ P.T)) @ freqs
 
         site_l = np.zeros(W)
         if site_rates is not None:
             for rate in np.unique(site_rates[gid]):
                 sel = site_rates[gid] == rate
                 site_l[sel] = root_site_l(float(rate))[sel]
+        elif is_lg4:
+            for r, (rate, w) in enumerate(zip(model.gamma_rates,
+                                              model.rate_weights)):
+                site_l += w * root_site_l(float(rate), cat=r)
         else:
             for rate in model.gamma_rates:
                 site_l += root_site_l(float(rate)) / model.ncat

@@ -1,0 +1,80 @@
+"""Constraint trees (-g): parsing, random resolution, SPR gating."""
+
+import numpy as np
+import pytest
+
+from examl_tpu.instance import PhyloInstance
+from examl_tpu.io.alignment import build_alignment_data
+from examl_tpu.search.snapshots import topology_key
+from examl_tpu.tree.constraint import load_constraint
+
+
+def _dna(ntaxa=10, nsites=200, seed=21):
+    rng = np.random.default_rng(seed)
+    cur = rng.integers(0, 4, nsites)
+    seqs = []
+    for _ in range(ntaxa):
+        flip = rng.random(nsites) < 0.2
+        cur = np.where(flip, rng.integers(0, 4, nsites), cur)
+        seqs.append("".join("ACGT"[c] for c in cur))
+    return build_alignment_data([f"t{i}" for i in range(ntaxa)], seqs)
+
+
+CONSTRAINT = "((t0,t1,t2,t3),(t4,t5,t6),t7,t8,t9);"
+
+
+def _is_monophyletic(tree, tips, ntips=10):
+    """A tip set is a clade iff it (or its complement, for sets containing
+    tip 1 — topology_key stores the side away from tip 1) is a stored
+    bipartition."""
+    bips = topology_key(tree)
+    s = frozenset(tips)
+    comp = frozenset(range(1, ntips + 1)) - s
+    return s in bips or comp in bips
+
+
+def test_load_constraint_resolves_and_labels():
+    data = _dna()
+    inst = PhyloInstance(data)
+    tree, con = load_constraint(CONSTRAINT, data.taxon_names, seed=5,
+                                num_branches=1)
+    # Binary and evaluable.
+    lnl = inst.evaluate(tree, full=True)
+    assert np.isfinite(lnl) and lnl < 0
+    # Tip labels: t0-t3 share a cluster, t4-t6 another, t7-t9 root level.
+    c = con.tip_cluster
+    assert len({c[1], c[2], c[3], c[4]}) == 1
+    assert len({c[5], c[6], c[7]}) == 1
+    assert c[1] != c[5]
+    assert c[8] == c[9] == c[10] == 0
+    # The resolved topology honors both clusters.
+    assert _is_monophyletic(tree, {1, 2, 3, 4})
+    assert _is_monophyletic(tree, {5, 6, 7})
+    # Different seeds give (usually) different resolutions, same clusters.
+    tree2, _ = load_constraint(CONSTRAINT, data.taxon_names, seed=6,
+                               num_branches=1)
+    assert _is_monophyletic(tree2, {1, 2, 3, 4})
+
+
+def test_load_constraint_requires_all_taxa():
+    data = _dna()
+    with pytest.raises(ValueError, match="exactly the alignment"):
+        load_constraint("((t0,t1),(t2,t3));", data.taxon_names, seed=1)
+
+
+@pytest.mark.slow
+def test_search_honors_constraint():
+    """A full search started from the resolved constraint keeps the
+    constraint clusters monophyletic."""
+    from examl_tpu.search.raxml_search import (SearchOptions,
+                                               compute_big_rapid)
+    data = _dna()
+    inst = PhyloInstance(data)
+    tree, con = load_constraint(CONSTRAINT, data.taxon_names, seed=5,
+                                num_branches=1)
+    lnl0 = inst.evaluate(tree, full=True)
+    opts = SearchOptions(initial_set=True, initial=5, constraint=con)
+    res = compute_big_rapid(inst, tree, opts)
+    assert res.likelihood >= lnl0
+    assert _is_monophyletic(tree, {1, 2, 3, 4}), "cluster (t0..t3) broken"
+    assert _is_monophyletic(tree, {5, 6, 7}), "cluster (t4..t6) broken"
