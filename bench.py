@@ -72,8 +72,9 @@ def main() -> None:
     @jax.jit
     def chained(clv, scaler):
         def body(_, cs):
-            return kernels.traverse(eng.models, eng.block_part, cs[0], cs[1],
-                                    tv, eng.scale_exp)
+            return kernels.traverse(eng.models, eng.block_part, eng.tips,
+                                    cs[0], cs[1], tv, eng.scale_exp,
+                                    eng.ntips)
         clv, scaler = jax.lax.fori_loop(0, n_steps, body, (clv, scaler))
         return jnp.sum(scaler)
 

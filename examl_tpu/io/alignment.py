@@ -59,7 +59,23 @@ class AlignmentData:
 
 
 def compress_patterns(codes: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
-    """Collapse duplicate columns of [ntaxa, width] into unique patterns + weights."""
+    """Collapse duplicate columns of [ntaxa, width] into unique patterns +
+    weights (reference `sitesort`/`sitecombcrunch`).
+
+    Uses the native C++ core (examl_tpu._patterncrunch, built by
+    setup.py) when available — the parser hot path on large alignments —
+    with a bit-identical NumPy fallback."""
+    try:
+        from examl_tpu import _patterncrunch
+    except ImportError:
+        _patterncrunch = None
+    if _patterncrunch is not None and codes.size:
+        codes = np.ascontiguousarray(codes, dtype=np.uint8)
+        pat_bytes, wgt_bytes, npat = _patterncrunch.compress_columns(codes)
+        patterns = np.frombuffer(pat_bytes, dtype=np.uint8).reshape(
+            codes.shape[0], npat)
+        weights = np.frombuffer(wgt_bytes, dtype=np.int64)
+        return patterns, weights
     cols = np.ascontiguousarray(codes.T)
     uniq, counts = np.unique(cols, axis=0, return_counts=True)
     return np.ascontiguousarray(uniq.T), counts.astype(np.int64)

@@ -100,7 +100,13 @@ class LikelihoodEngine:
         self.num_branch_slots = num_branch_slots
         self.wave_width = wave_width
         self.num_parts = bucket.num_parts
-        self.num_rows = 2 * ntips - 1          # node rows + 1 scratch
+        # CLV rows hold INNER nodes only (numbers ntips+1..2n-2 -> rows
+        # 0..n-3) plus one scratch row; tips live as packed uint8 codes
+        # with an indicator lookup table, materialized on the fly inside
+        # the kernels (the reference's yVector + tipVector scheme,
+        # `axml.h:533-629` -- tip CLVs are never stored, which more than
+        # halves likelihood-buffer memory).
+        self.num_rows = ntips - 1
         self.scratch_row = self.num_rows - 1
         self.sharding = sharding
 
@@ -124,12 +130,9 @@ class LikelihoodEngine:
         self.weights = jnp.asarray(
             bucket.weights.reshape(B, lane), dtype=self.dtype)
 
-        # Tip CLVs: indicator vectors per code, broadcast across rates.
-        tip = self._build_tip_clvs()
-        clv = jnp.zeros((self.num_rows, B, lane, self.R, self.K),
-                        dtype=self.dtype)
-        clv = clv.at[:ntips].set(tip)
-        self.clv = clv
+        self.tips = self._build_tip_state()
+        self.clv = jnp.zeros((self.num_rows, B, lane, self.R, self.K),
+                             dtype=self.dtype)
         self.scaler = jnp.zeros((self.num_rows, B, lane), dtype=jnp.int32)
         if sharding is not None:
             self.apply_sharding(sharding)
@@ -140,8 +143,9 @@ class LikelihoodEngine:
         # never read again.  site_rates rides along as a traced argument
         # (None on the GAMMA path).
         self._jit_traverse = jax.jit(
-            lambda clv, scaler, tv, dm, block_part, sr: kernels.traverse(
-                dm, block_part, clv, scaler, tv, self.scale_exp, sr),
+            lambda clv, scaler, tv, dm, block_part, tips, sr:
+                kernels.traverse(dm, block_part, tips, clv, scaler, tv,
+                                 self.scale_exp, self.ntips, sr),
             donate_argnums=(0, 1))
         self._jit_evaluate = jax.jit(self._evaluate_impl)
         self._jit_trav_eval = jax.jit(self._trav_eval_impl,
@@ -153,7 +157,7 @@ class LikelihoodEngine:
 
     # -- construction helpers ---------------------------------------------
 
-    def _build_tip_clvs(self) -> jax.Array:
+    def _build_tip_state(self) -> kernels.TipState:
         from examl_tpu import datatypes
         if self.K == 4:
             dt = datatypes.DNA
@@ -162,17 +166,18 @@ class LikelihoodEngine:
         else:
             dt = datatypes.BINARY
         table = jnp.asarray(dt.tip_indicator_table(), dtype=self.dtype)
-        codes = jnp.asarray(self.bucket.tip_codes.astype(np.int32))
-        tip = table[codes]                                   # [ntaxa, S, K]
-        tip = tip.reshape(self.ntips, self.B, self.lane, 1, self.K)
-        return jnp.broadcast_to(
-            tip, (self.ntips, self.B, self.lane, self.R, self.K))
+        codes = self.bucket.tip_codes.astype(np.uint8).reshape(
+            self.ntips, self.B, self.lane)
+        return kernels.TipState(codes=jnp.asarray(codes), table=table)
 
     def apply_sharding(self, sharding) -> None:
         """Shard the block axis of the big per-site tensors."""
         self.sharding = sharding
         self.clv = jax.device_put(self.clv, sharding.clv)
         self.scaler = jax.device_put(self.scaler, sharding.scaler)
+        self.tips = kernels.TipState(
+            codes=jax.device_put(self.tips.codes, sharding.scaler),
+            table=jax.device_put(self.tips.table, sharding.replicated))
         self.weights = jax.device_put(self.weights, sharding.sites)
         self.block_part = jax.device_put(self.block_part, sharding.blocks)
 
@@ -181,7 +186,7 @@ class LikelihoodEngine:
                                    psr=self.psr)
 
     def invalidate_tips_changed(self) -> None:
-        self.clv = self.clv.at[:self.ntips].set(self._build_tip_clvs())
+        self.tips = self._build_tip_state()
 
     # -- traversal ---------------------------------------------------------
 
@@ -212,7 +217,7 @@ class LikelihoodEngine:
         zr = np.ones((L, W, C), dtype=np.float64)
         for li, wave in enumerate(waves):
             for wi, e in enumerate(wave):
-                parent[li, wi] = e.parent - 1
+                parent[li, wi] = e.parent - self.ntips - 1
                 left[li, wi] = e.left - 1
                 right[li, wi] = e.right - 1
                 zl[li, wi, :] = _z_slots(e.zl, C)
@@ -234,15 +239,15 @@ class LikelihoodEngine:
         tv = self._traversal_arrays(entries)
         self.clv, self.scaler = self._jit_traverse(
             self.clv, self.scaler, tv, self.models, self.block_part,
-            self.site_rates)
+            self.tips, self.site_rates)
 
     # -- evaluation --------------------------------------------------------
 
-    def _evaluate_impl(self, clv, scaler, p_row, q_row, z, dm, block_part,
-                       weights, sr):
+    def _evaluate_impl(self, clv, scaler, p_idx, q_idx, z, dm, block_part,
+                       weights, tips, sr):
         return kernels.root_log_likelihood(
-            dm, block_part, weights, clv, scaler,
-            p_row, q_row, z, self.num_parts, self.scale_exp, sr)
+            dm, block_part, weights, tips, clv, scaler,
+            p_idx, q_idx, z, self.num_parts, self.scale_exp, self.ntips, sr)
 
     def evaluate(self, p_num: int, q_num: int, z: Sequence[float]) -> np.ndarray:
         """Per-partition lnL [M] at branch (p,q); CLVs must be current."""
@@ -250,7 +255,7 @@ class LikelihoodEngine:
         out = self._jit_evaluate(self.clv, self.scaler,
                                  jnp.int32(p_num - 1), jnp.int32(q_num - 1),
                                  zv, self.models, self.block_part,
-                                 self.weights, self.site_rates)
+                                 self.weights, self.tips, self.site_rates)
         return np.asarray(out)
 
     # -- fused single-dispatch entry points ---------------------------------
@@ -259,13 +264,13 @@ class LikelihoodEngine:
     # evaluateGeneric and one per NR iteration (SURVEY §3.2-3.3); here each
     # search step is a single dispatch.
 
-    def _trav_eval_impl(self, clv, scaler, tv, p_row, q_row, z, dm,
-                        block_part, weights, sr):
-        clv, scaler = kernels.traverse(dm, block_part, clv, scaler, tv,
-                                       self.scale_exp, sr)
+    def _trav_eval_impl(self, clv, scaler, tv, p_idx, q_idx, z, dm,
+                        block_part, weights, tips, sr):
+        clv, scaler = kernels.traverse(dm, block_part, tips, clv, scaler,
+                                       tv, self.scale_exp, self.ntips, sr)
         lnl = kernels.root_log_likelihood(
-            dm, block_part, weights, clv, scaler, p_row, q_row, z,
-            self.num_parts, self.scale_exp, sr)
+            dm, block_part, weights, tips, clv, scaler, p_idx, q_idx, z,
+            self.num_parts, self.scale_exp, self.ntips, sr)
         return clv, scaler, lnl
 
     def traverse_evaluate(self, entries: List[TraversalEntry], p_num: int,
@@ -275,14 +280,16 @@ class LikelihoodEngine:
         self.clv, self.scaler, out = self._jit_trav_eval(
             self.clv, self.scaler, tv, jnp.int32(p_num - 1),
             jnp.int32(q_num - 1), zv, self.models, self.block_part,
-            self.weights, self.site_rates)
+            self.weights, self.tips, self.site_rates)
         return np.asarray(out)
 
-    def _newton_impl(self, clv, scaler, tv, p_row, q_row, z0, maxiters,
-                     conv, dm, block_part, weights, sr):
-        clv, scaler = kernels.traverse(dm, block_part, clv, scaler, tv,
-                                       self.scale_exp, sr)
-        st = kernels.sumtable(dm, block_part, clv[p_row], clv[q_row])
+    def _newton_impl(self, clv, scaler, tv, p_idx, q_idx, z0, maxiters,
+                     conv, dm, block_part, weights, tips, sr):
+        clv, scaler = kernels.traverse(dm, block_part, tips, clv, scaler,
+                                       tv, self.scale_exp, self.ntips, sr)
+        xp, _ = kernels.gather_child(tips, clv, scaler, p_idx, self.ntips)
+        xq, _ = kernels.gather_child(tips, clv, scaler, q_idx, self.ntips)
+        st = kernels.sumtable(dm, block_part, xp, xq)
         z = kernels.newton_raphson_branch(dm, block_part, weights, st, z0,
                                           maxiters, conv,
                                           self.num_branch_slots, sr)
@@ -300,28 +307,27 @@ class LikelihoodEngine:
             self.clv, self.scaler, tv, jnp.int32(p_num - 1),
             jnp.int32(q_num - 1), jnp.asarray(z0),
             jnp.full(C, maxiter, dtype=jnp.int32), jnp.asarray(conv_mask),
-            self.models, self.block_part, self.weights, self.site_rates)
+            self.models, self.block_part, self.weights, self.tips,
+            self.site_rates)
         return np.asarray(z, dtype=np.float64)
 
     # -- PSR rate-grid scan -------------------------------------------------
 
-    def _rate_scan_impl(self, tips, tv, p_row, q_row, z, grid, dm,
+    def _rate_scan_impl(self, tips, tv, p_idx, q_idx, z, grid, dm,
                         block_part):
         """Full traversal + per-site-per-candidate root lnL for one grid
         chunk [B, lane, G]; scratch CLVs live only inside this program."""
         G = grid.shape[2]
         clv = jnp.zeros((self.num_rows, self.B, self.lane, G, self.K),
                         dtype=self.dtype)
-        clv = clv.at[:self.ntips].set(
-            jnp.broadcast_to(tips, (self.ntips, self.B, self.lane, G,
-                                    self.K)))
         scaler = jnp.zeros((self.num_rows, self.B, self.lane),
                            dtype=jnp.int32)
-        clv, scaler = kernels.traverse(dm, block_part, clv, scaler, tv,
-                                       self.scale_exp, grid)
-        return kernels.per_rate_site_lnls(dm, block_part, clv, scaler,
-                                          p_row, q_row, z, grid,
-                                          self.scale_exp)
+        clv, scaler = kernels.traverse(dm, block_part, tips, clv, scaler,
+                                       tv, self.scale_exp, self.ntips,
+                                       grid)
+        return kernels.per_rate_site_lnls(dm, block_part, tips, clv,
+                                          scaler, p_idx, q_idx, z, grid,
+                                          self.scale_exp, self.ntips)
 
     def rate_scan(self, entries: List[TraversalEntry], p_num: int,
                   q_num: int, z: Sequence[float],
@@ -336,7 +342,7 @@ class LikelihoodEngine:
         tv = self._traversal_arrays(entries)
         zv = jnp.asarray(_z_slots(z, self.num_branch_slots), dtype=self.dtype)
         out = self._jit_rate_scan(
-            self.clv[:self.ntips], tv, jnp.int32(p_num - 1),
+            self.tips, tv, jnp.int32(p_num - 1),
             jnp.int32(q_num - 1), zv,
             jnp.asarray(grid, dtype=self.dtype), self.models,
             self.block_part)
@@ -344,17 +350,21 @@ class LikelihoodEngine:
 
     # -- branch derivatives ------------------------------------------------
 
-    def _sumtable_impl(self, clv, p_row, q_row, dm, block_part):
-        return kernels.sumtable(dm, block_part, clv[p_row], clv[q_row])
+    def _sumtable_impl(self, clv, scaler, p_idx, q_idx, dm, block_part,
+                       tips):
+        xp, _ = kernels.gather_child(tips, clv, scaler, p_idx, self.ntips)
+        xq, _ = kernels.gather_child(tips, clv, scaler, q_idx, self.ntips)
+        return kernels.sumtable(dm, block_part, xp, xq)
 
     def _derivs_impl(self, st, z, dm, block_part, weights, sr):
         return kernels.nr_derivatives(dm, block_part, weights,
                                       st, z, self.num_branch_slots, sr)
 
     def make_sumtable(self, p_num: int, q_num: int) -> jax.Array:
-        return self._jit_sumtable(self.clv, jnp.int32(p_num - 1),
+        return self._jit_sumtable(self.clv, self.scaler,
+                                  jnp.int32(p_num - 1),
                                   jnp.int32(q_num - 1), self.models,
-                                  self.block_part)
+                                  self.block_part, self.tips)
 
     def branch_derivatives(self, st: jax.Array, z: Sequence[float]):
         zv = jnp.asarray(_z_slots(z, self.num_branch_slots), dtype=self.dtype)

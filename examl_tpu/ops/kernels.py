@@ -67,14 +67,50 @@ class Traversal(NamedTuple):
 
     Entries are wave-scheduled (`Tree.schedule_waves`): axis 0 runs over
     dependency waves executed sequentially, axis 1 over the independent
-    entries of a wave executed as one batched newview.  Padding entries
-    point children at row 0 and the parent at the scratch row.
+    entries of a wave executed as one batched newview.  `parent` indexes
+    INNER CLV rows (node number - ntips - 1); `left`/`right` are 0-based
+    node indices (tips < ntips resolve against the tip-code table, the
+    reference's yVector+tipVector scheme — tip CLVs are never stored).
+    Padding entries point children at node 0 and the parent at the
+    scratch row.
     """
-    parent: jax.Array       # [L, W] int32 CLV row
-    left: jax.Array         # [L, W] int32
+    parent: jax.Array       # [L, W] int32 inner CLV row
+    left: jax.Array         # [L, W] int32 node index (tip or inner)
     right: jax.Array        # [L, W] int32
     zl: jax.Array           # [L, W, C] branch z to left child
     zr: jax.Array           # [L, W, C]
+
+
+class TipState(NamedTuple):
+    """Device-resident tip data: packed codes + indicator lookup table."""
+    codes: jax.Array        # [ntips, B, lane] uint8/int32 state codes
+    table: jax.Array        # [num_codes, K] 0/1 indicator vectors
+
+
+def gather_child(tips: TipState, clv: jax.Array, scaler: jax.Array,
+                 idx: jax.Array, ntips: int):
+    """CLV + scaler of child nodes given 0-based node indices idx [...].
+
+    Tips (idx < ntips) materialize their indicator vectors from the code
+    table on the fly (scaler 0); inner nodes read the stored CLV row
+    (idx - ntips).  Both gathers run and a select picks — the tip gather
+    is a uint8 lookup, negligible next to the CLV read it replaces.
+    """
+    R = clv.shape[3]
+    idx = jnp.asarray(idx)          # plain ints (static callers) included
+    is_tip = idx < ntips
+    tip_idx = jnp.clip(idx, 0, ntips - 1)
+    codes = tips.codes[tip_idx]                      # [..., B, lane]
+    tip_clv = tips.table[codes]                      # [..., B, lane, K]
+    tip_clv = jnp.broadcast_to(
+        tip_clv[..., :, :, None, :],
+        tip_clv.shape[:-1] + (R, tip_clv.shape[-1]))
+    inner_idx = jnp.clip(idx - ntips, 0, clv.shape[0] - 1)
+    inner_clv = clv[inner_idx]
+    sel = is_tip[..., None, None, None, None]
+    x = jnp.where(sel, tip_clv, inner_clv)
+    sc = jnp.where(is_tip[..., None, None], 0, scaler[inner_idx])
+    return x, sc
 
 
 def default_scale_exponent(dtype, backend: str | None = None) -> int:
@@ -200,24 +236,27 @@ def newview_wave(models: DeviceModels, block_part: jax.Array,
     return v, needs.astype(jnp.int32)
 
 
-def traverse(models: DeviceModels, block_part: jax.Array,
+def traverse(models: DeviceModels, block_part: jax.Array, tips: TipState,
              clv: jax.Array, scaler: jax.Array, tv: Traversal,
-             scale_exp: int, site_rates=None):
+             scale_exp: int, ntips: int, site_rates=None):
     """Execute a wave-scheduled traversal: lax.scan over waves, each wave a
     batched newview over its independent entries.
 
-    clv: [N, B, lane, R, K]; scaler: [N, B, lane] int32.
-    Padding entries write to the scratch row (host sets parent=N-1); within
-    a wave the scatter indices are unique except for scratch duplicates,
-    whose value is never read.
+    clv: [Ninner, B, lane, R, K]; scaler: [Ninner, B, lane] int32 (inner
+    nodes + one scratch row; tip children materialize from `tips`).
+    Padding entries write to the scratch row (host sets parent=Ninner-1);
+    within a wave the scatter indices are unique except for scratch
+    duplicates, whose value is never read.
     Reference: `newviewIterative` (`newviewGenericSpecial.c:917-1515`).
     """
     def body(carry, e):
         clv, scaler = carry
         parent, left, right, zl, zr = e
-        v, inc = newview_wave(models, block_part, clv[left], clv[right],
+        xl, sl = gather_child(tips, clv, scaler, left, ntips)
+        xr, sr = gather_child(tips, clv, scaler, right, ntips)
+        v, inc = newview_wave(models, block_part, xl, xr,
                               zl, zr, scale_exp, site_rates)
-        sc = scaler[left] + scaler[right] + inc             # [W, B, lane]
+        sc = sl + sr + inc                                  # [W, B, lane]
         clv = clv.at[parent].set(v, unique_indices=False)
         scaler = scaler.at[parent].set(sc, unique_indices=False)
         return (clv, scaler), None
@@ -248,8 +287,9 @@ def site_likelihoods(models: DeviceModels, block_part: jax.Array,
 
 
 def per_rate_site_lnls(models: DeviceModels, block_part: jax.Array,
-                       clv: jax.Array, scaler: jax.Array, p_row, q_row,
-                       z: jax.Array, site_rates: jax.Array, scale_exp: int):
+                       tips: TipState, clv: jax.Array, scaler: jax.Array,
+                       p_idx, q_idx, z: jax.Array, site_rates: jax.Array,
+                       scale_exp: int, ntips: int):
     """Per-site, per-rate-candidate log likelihood [B, lane, R].
 
     The batched on-device replacement for the reference's per-site rate
@@ -257,33 +297,38 @@ def per_rate_site_lnls(models: DeviceModels, block_part: jax.Array,
     `optimizeModel.c:1792-1922`): one traversal per rate-grid chunk
     produces every site's lnL under every candidate rate at once.
     """
+    xp, sp = gather_child(tips, clv, scaler, p_idx, ntips)
+    xq, sq = gather_child(tips, clv, scaler, q_idx, ntips)
     d = psr_decay(models, block_part, site_rates, z)
-    y = apply_p_factorized(models, block_part, d, clv[q_row])
+    y = apply_p_factorized(models, block_part, d, xq)
     fb = models.freqs[block_part][:, 0]                     # [B, K] (PSR)
-    lsite = einsum("bk,blrk,blrk->blr", fb, clv[p_row], y)  # [B, lane, R]
+    lsite = einsum("bk,blrk,blrk->blr", fb, xp, y)          # [B, lane, R]
     acc = _acc_dtype(lsite.dtype)
     _, _, log_min = scale_constants(acc, scale_exp)
-    sc = (scaler[p_row] + scaler[q_row]).astype(acc)        # [B, lane]
+    sc = (sp + sq).astype(acc)                              # [B, lane]
     lsite = jnp.maximum(lsite, jnp.finfo(lsite.dtype).tiny)
     return jnp.log(lsite).astype(acc) + sc[:, :, None] * log_min
 
 
 def root_log_likelihood(models: DeviceModels, block_part: jax.Array,
-                        weights: jax.Array, clv: jax.Array, scaler: jax.Array,
-                        p_row, q_row, z: jax.Array, num_parts: int,
-                        scale_exp: int, site_rates=None):
+                        weights: jax.Array, tips: TipState,
+                        clv: jax.Array, scaler: jax.Array,
+                        p_idx, q_idx, z: jax.Array, num_parts: int,
+                        scale_exp: int, ntips: int, site_rates=None):
     """Per-partition log likelihoods [M] after a traversal.
 
-    weights: [B, lane] pattern weights (0 on padding).
+    weights: [B, lane] pattern weights (0 on padding); p_idx/q_idx are
+    0-based node indices (tip or inner).
     Reference: `evaluateGeneric` + the lnL Allreduce
     (`evaluateGenericSpecial.c:897-1001`); here the cross-device sum is the
     segment/jnp sum over the sharded block axis (XLA inserts the collective).
     """
-    lsite = site_likelihoods(models, block_part, clv[p_row], clv[q_row], z,
-                             site_rates)
+    xp, sp = gather_child(tips, clv, scaler, p_idx, ntips)
+    xq, sq = gather_child(tips, clv, scaler, q_idx, ntips)
+    lsite = site_likelihoods(models, block_part, xp, xq, z, site_rates)
     acc = _acc_dtype(lsite.dtype)
     _, _, log_min = scale_constants(acc, scale_exp)
-    sc = (scaler[p_row] + scaler[q_row]).astype(acc)
+    sc = (sp + sq).astype(acc)
     lsite = jnp.maximum(lsite, jnp.finfo(lsite.dtype).tiny)
     site_lnl = weights.astype(acc) * (jnp.log(lsite).astype(acc)
                                       + sc * log_min)       # [B, lane]

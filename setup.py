@@ -1,0 +1,23 @@
+"""Build script for the native runtime components.
+
+    python setup.py build_ext --inplace
+
+produces examl_tpu/_patterncrunch*.so, the C++ pattern-compression core
+used by the parser pipeline (io/alignment.py falls back to the NumPy path
+when the extension has not been built).
+"""
+
+from setuptools import Extension, setup
+
+setup(
+    name="examl-tpu-native",
+    version="0.1",
+    ext_modules=[
+        Extension(
+            "examl_tpu._patterncrunch",
+            sources=["native/patterncrunch.cpp"],
+            extra_compile_args=["-O3", "-std=c++17"],
+            language="c++",
+        ),
+    ],
+)

@@ -1,0 +1,81 @@
+"""Measure the reference AVX build's newview throughput -> avx_baseline.json.
+
+Recipe (run pieces by hand; each step is idempotent):
+
+1. bash tools/build_reference.sh            # parser + pristine examl-AVX
+2. Copy the engine to a scratch dir and instrument newviewIterative with a
+   wall-time + site-update counter (the patch below), then rebuild:
+
+     cp -r /root/reference/examl /tmp/refbench
+     python tools/bench_reference.py patch /tmp/refbench
+     make -C /tmp/refbench -f Makefile.AVX.gcc CC=gcc \
+          CPPFLAGS="-I$PWD/tools/mpistub"
+
+3. Run a representative workload; the instrumented binary prints
+   "BENCH_NEWVIEW updates=<N> seconds=<s> rate=<r>" at exit:
+
+     /tmp/refparser/parse-examl -s testData/140 -q 140.model -m PROT -n t140
+     /tmp/refbench/examl-AVX -s t140.binary -t 140.tree -m GAMMA \
+          -n B140 -f e -w out/
+
+4. Record the per-core rate in tools/avx_baseline.json (one socket =
+   per-core rate x cores; the reference runs one rank per core).
+
+Measured 2026-07-29 on Intel Xeon @2.10GHz: 159.6M site-CLV updates/s/core
+(63.5G updates in 398s inside newviewIterative during the 140-taxon
+tree-evaluation workload).
+"""
+
+from __future__ import annotations
+
+import sys
+
+INJECT = '''
+/* BENCH instrumentation (scratch copy only). */
+#include <sys/time.h>
+double bench_newview_seconds = 0.0;
+unsigned long long bench_newview_updates = 0ULL;
+static double bench_now(void){ struct timeval t; gettimeofday(&t, NULL); return t.tv_sec + 1e-6*t.tv_usec; }
+__attribute__((destructor)) static void bench_report(void){
+  fprintf(stderr, "BENCH_NEWVIEW updates=%llu seconds=%f rate=%f\\n",
+          bench_newview_updates, bench_newview_seconds,
+          bench_newview_seconds > 0 ? bench_newview_updates / bench_newview_seconds : 0.0);
+}
+'''
+
+COUNT_AFTER = ("int\n\t    categories,\n"
+               "\t    states = tr->partitionData[model].states;")
+COUNT_CODE = '''
+	  bench_newview_updates += (unsigned long long)tr->partitionData[model].width
+	      * (unsigned long long)states
+	      * (unsigned long long)((tr->rateHetModel == CAT) ? 1 : 4);'''
+
+
+def patch(srcdir: str) -> None:
+    path = f"{srcdir}/newviewGenericSpecial.c"
+    src = open(path).read()
+    if "BENCH_NEWVIEW" in src:
+        print("already patched")
+        return
+    head = "void newviewIterative (tree *tr, int startIndex)"
+    wrapper = INJECT + '''
+static void newviewIterative_inner (tree *tr, int startIndex);
+void newviewIterative (tree *tr, int startIndex)
+{
+  double t0 = bench_now();
+  newviewIterative_inner(tr, startIndex);
+  bench_newview_seconds += bench_now() - t0;
+}
+static void newviewIterative_inner (tree *tr, int startIndex)'''
+    assert head in src and COUNT_AFTER in src
+    src = src.replace(head, wrapper, 1)
+    src = src.replace(COUNT_AFTER, COUNT_AFTER + COUNT_CODE, 1)
+    open(path, "w").write(src)
+    print(f"patched {path}")
+
+
+if __name__ == "__main__":
+    if len(sys.argv) == 3 and sys.argv[1] == "patch":
+        patch(sys.argv[2])
+    else:
+        print(__doc__)
