@@ -60,11 +60,12 @@ def main() -> None:
 
     import jax.numpy as jnp
 
-    from examl_tpu.ops import kernels
+    from examl_tpu.ops import fastpath
 
     eng = inst.engines[20]
-    _, entries = tree.full_traversal()
-    tv = eng._traversal_arrays(entries)
+    _, entries = tree.full_traversal_centroid()
+    sched = eng._fast_schedule(entries)
+    chunks = sched.chunks
     n_steps = 50
 
     # n_steps dependency-chained traversals inside ONE jit returning a
@@ -72,16 +73,19 @@ def main() -> None:
     @jax.jit
     def chained(clv, scaler):
         def body(_, cs):
-            return kernels.traverse(eng.models, eng.block_part, eng.tips,
-                                    cs[0], cs[1], tv, eng.scale_exp,
-                                    eng.ntips)
+            return fastpath.run_chunks(eng.models, eng.block_part, eng.tips,
+                                       cs[0], cs[1], chunks, eng.scale_exp,
+                                       eng.fast_precision)
         clv, scaler = jax.lax.fori_loop(0, n_steps, body, (clv, scaler))
         return jnp.sum(scaler)
 
     float(chained(eng.clv, eng.scaler))      # compile + warm
-    t0 = time.perf_counter()
-    float(chained(eng.clv, eng.scaler))
-    dt = time.perf_counter() - t0
+    best = 1e18
+    for _ in range(3):
+        t0 = time.perf_counter()
+        float(chained(eng.clv, eng.scaler))
+        best = min(best, time.perf_counter() - t0)
+    dt = best
 
     patterns = sum(p.width for p in inst.alignment.partitions)
     rates, states = eng.R, eng.K

@@ -161,13 +161,13 @@ class PhyloInstance:
         self.run_traversal(entries)
 
     def run_traversal(self, entries: List[TraversalEntry],
-                      only_states=None) -> None:
+                      only_states=None, full: bool = False) -> None:
         if not entries:
             return
         for states, eng in self.engines.items():
             if only_states is not None and states not in only_states:
                 continue
-            eng.run_traversal(entries)
+            eng.run_traversal(entries, full=full)
 
     # -- likelihood --------------------------------------------------------
 
@@ -193,7 +193,8 @@ class PhyloInstance:
             if only_states is not None and states not in only_states:
                 continue
             # Fused traversal + root evaluation: one dispatch per engine.
-            vals = eng.traverse_evaluate(entries, p.number, q.number, p.z)
+            vals = eng.traverse_evaluate(entries, p.number, q.number, p.z,
+                                         full=full)
             for li, gid in enumerate(eng.bucket.part_ids):
                 per_part[gid] = vals[li]
         if only_states is not None and np.isnan(per_part).any():

@@ -1,9 +1,11 @@
 """Newick tree string read/write.
 
-Role of reference `treeIO.c` (`treeReadLen` :798, `Tree2String` :324), as a
-plain recursive-descent parser over an in-memory string.  Branch lengths in
-newick are expected substitutions per site t; internally branches are stored
-as z = exp(-t) like the reference.
+Role of reference `treeIO.c` (`treeReadLen` :798, `Tree2String` :324) over
+an in-memory string.  Branch lengths in newick are expected substitutions
+per site t; internally branches are stored as z = exp(-t) like the
+reference.  Parsing and formatting are iterative (explicit stacks): tree
+height is O(n) on caterpillar trees and the reference ambition is ~120k
+taxa (SURVEY §6), far past Python's recursion limit.
 """
 
 from __future__ import annotations
@@ -23,11 +25,13 @@ class NewickNode:
         return not self.children
 
     def leaves(self):
-        if self.is_leaf:
-            yield self
-        else:
-            for c in self.children:
-                yield from c.leaves()
+        stack = [self]
+        while stack:
+            n = stack.pop()
+            if n.is_leaf:
+                yield n
+            else:
+                stack.extend(reversed(n.children))
 
 
 class _Parser:
@@ -53,20 +57,38 @@ class _Parser:
         return node
 
     def parse_clade(self) -> NewickNode:
-        node = NewickNode()
-        if self.peek() == "(":
-            self.take()
-            node.children.append(self.parse_clade())
-            while self.peek() == ",":
+        # Iterative recursive-descent: `open_stack` holds clades whose
+        # child list is still being read.
+        open_stack: List[NewickNode] = []
+        current: Optional[NewickNode] = None
+        while True:
+            if self.peek() == "(":
+                if current is not None:
+                    raise ValueError(
+                        f"newick: unexpected '(' after clade at {self.pos}")
                 self.take()
-                node.children.append(self.parse_clade())
-            if self.take() != ")":
-                raise ValueError(f"newick: expected ')' at {self.pos}")
-        node.name = self.parse_label()
-        if self.peek() == ":":
-            self.take()
-            node.length = self.parse_number()
-        return node
+                parent = NewickNode()
+                open_stack.append(parent)
+                continue
+            # parse one leaf/closed clade's label and length
+            node = current if current is not None else NewickNode()
+            current = None
+            node.name = self.parse_label() or node.name
+            if self.peek() == ":":
+                self.take()
+                node.length = self.parse_number()
+            if not open_stack:
+                return node
+            open_stack[-1].children.append(node)
+            ch = self.peek()
+            if ch == ",":
+                self.take()
+                continue
+            if ch == ")":
+                self.take()
+                current = open_stack.pop()
+                continue
+            raise ValueError(f"newick: expected ',' or ')' at {self.pos}")
 
     def parse_label(self) -> Optional[str]:
         if self.peek() == "'":
@@ -106,14 +128,28 @@ def parse_newick(text: str) -> NewickNode:
 
 def format_newick(root: NewickNode, with_lengths: bool = True,
                   fmt: str = "%.6f") -> str:
-    def rec(node: NewickNode) -> str:
+    out: List[str] = []
+    # (node, state): state 0 = entering, child index otherwise.
+    stack: List[tuple] = [(root, 0)]
+    while stack:
+        node, state = stack.pop()
         if node.is_leaf:
-            s = node.name or ""
+            out.append(node.name or "")
+            if with_lengths and node.length is not None:
+                out.append(":" + (fmt % node.length))
+            continue
+        if state == 0:
+            out.append("(")
         else:
-            s = "(" + ",".join(rec(c) for c in node.children) + ")"
-            if node.name:
-                s += node.name
-        if with_lengths and node.length is not None:
-            s += ":" + (fmt % node.length)
-        return s
-    return rec(root) + ";"
+            if state < len(node.children):
+                out.append(",")
+            else:
+                out.append(")")
+                if node.name:
+                    out.append(node.name)
+                if with_lengths and node.length is not None:
+                    out.append(":" + (fmt % node.length))
+                continue
+        stack.append((node, state + 1))
+        stack.append((node.children[state], 0))
+    return "".join(out) + ";"

@@ -100,14 +100,24 @@ class LikelihoodEngine:
         self.num_branch_slots = num_branch_slots
         self.wave_width = wave_width
         self.num_parts = bucket.num_parts
-        # CLV rows hold INNER nodes only (numbers ntips+1..2n-2 -> rows
-        # 0..n-3) plus one scratch row; tips live as packed uint8 codes
-        # with an indicator lookup table, materialized on the fly inside
-        # the kernels (the reference's yVector + tipVector scheme,
-        # `axml.h:533-629` -- tip CLVs are never stored, which more than
-        # halves likelihood-buffer memory).
-        self.num_rows = ntips - 1
+        # CLV rows hold INNER nodes only plus one scratch row; tips live as
+        # packed uint8 codes with an indicator lookup table, materialized on
+        # the fly inside the kernels (the reference's yVector + tipVector
+        # scheme, `axml.h:533-629` -- tip CLVs are never stored, which more
+        # than halves likelihood-buffer memory).  Row assignment is a HOST
+        # map (`row_map`): full traversals relayout rows in wave order so
+        # the fast path writes contiguous slices (ops/fastpath.py); partial
+        # traversals update rows in place through the map.  The arena keeps
+        # `fast_slack` rows of headroom for the fast path's padded writes.
+        self.n_inner = max(ntips - 2, 1)
+        self.fast_slack = 0 if psr else min(64, _next_pow2(ntips))
+        self.num_rows = self.n_inner + self.fast_slack + 1
         self.scratch_row = self.num_rows - 1
+        self.row_map = np.full(2 * ntips - 1, -1, dtype=np.int64)
+        for num in range(ntips + 1, 2 * ntips - 1):
+            self.row_map[num] = num - ntips - 1
+        self.fast_precision = jax.lax.Precision.HIGHEST
+        self._fast_jit_cache = {}
         self.sharding = sharding
 
         lane = bucket.lane
@@ -217,9 +227,9 @@ class LikelihoodEngine:
         zr = np.ones((L, W, C), dtype=np.float64)
         for li, wave in enumerate(waves):
             for wi, e in enumerate(wave):
-                parent[li, wi] = e.parent - self.ntips - 1
-                left[li, wi] = e.left - 1
-                right[li, wi] = e.right - 1
+                parent[li, wi] = self.row_map[e.parent]
+                left[li, wi] = self._gidx(e.left)
+                right[li, wi] = self._gidx(e.right)
                 zl[li, wi, :] = _z_slots(e.zl, C)
                 zr[li, wi, :] = _z_slots(e.zr, C)
         return Traversal(parent=jnp.asarray(parent), left=jnp.asarray(left),
@@ -227,19 +237,89 @@ class LikelihoodEngine:
                          zl=jnp.asarray(zl, dtype=self.dtype),
                          zr=jnp.asarray(zr, dtype=self.dtype))
 
+    def _gidx(self, num: int) -> int:
+        """gather_child index of a node: tips by code slot, inner nodes by
+        ntips + current arena row (see kernels.gather_child)."""
+        if num <= self.ntips:
+            return num - 1
+        return self.ntips + int(self.row_map[num])
+
     def set_site_rates(self, rates: np.ndarray) -> None:
         """Install per-site rate multipliers [B, lane] (PSR model)."""
         assert self.psr
         self.site_rates = jnp.asarray(
             rates.reshape(self.B, self.lane, 1), dtype=self.dtype)
 
-    def run_traversal(self, entries: List[TraversalEntry]) -> None:
+    def run_traversal(self, entries: List[TraversalEntry],
+                      full: bool = False) -> None:
         if not entries:
+            return
+        if full and self._fast_eligible(entries):
+            sched = self._fast_schedule(entries)
+            fn = self._fast_fn(sched.profile, with_eval=False)
+            data = tuple((c.base, c.lidx, c.ridx, c.lcode, c.rcode,
+                          c.zl, c.zr) for c in sched.chunks)
+            self.clv, self.scaler = fn(self.clv, self.scaler, data,
+                                       self.models, self.block_part,
+                                       self.tips)
+            self._install_row_map(sched)
             return
         tv = self._traversal_arrays(entries)
         self.clv, self.scaler = self._jit_traverse(
             self.clv, self.scaler, tv, self.models, self.block_part,
             self.tips, self.site_rates)
+
+    # -- fast full-traversal path (ops/fastpath.py) ------------------------
+
+    def _fast_eligible(self, entries: List[TraversalEntry]) -> bool:
+        """The fast path relayouts the whole arena, so it requires a
+        traversal covering every inner node (full=True callers after
+        invalidate_all) and the GAMMA kernels (PSR keeps the scan path)."""
+        return (not self.psr and self.fast_slack > 0
+                and len(entries) == self.n_inner)
+
+    def _fast_schedule(self, entries: List[TraversalEntry]):
+        from examl_tpu.ops import fastpath
+        sched = fastpath.build_schedule(entries, self.ntips,
+                                        self.num_branch_slots, self.dtype)
+        assert sched.max_write <= self.num_rows - 1, \
+            (sched.max_write, self.num_rows)
+        return sched
+
+    def _install_row_map(self, sched) -> None:
+        self.row_map[:] = -1
+        for num, row in sched.row_of.items():
+            self.row_map[num] = row
+
+    def _fast_fn(self, profile, with_eval: bool):
+        key = (profile, with_eval)
+        fn = self._fast_jit_cache.get(key)
+        if fn is not None:
+            return fn
+        from examl_tpu.ops import fastpath
+
+        def impl_eval(clv, scaler, chunk_data, p_idx, q_idx, z, dm,
+                      block_part, weights, tips):
+            chunks = [fastpath.FastChunk(kind, width, *cd)
+                      for (kind, width), cd in zip(profile, chunk_data)]
+            clv, scaler = fastpath.run_chunks(
+                dm, block_part, tips, clv, scaler, chunks,
+                self.scale_exp, self.fast_precision)
+            lnl = kernels.root_log_likelihood(
+                dm, block_part, weights, tips, clv, scaler, p_idx, q_idx,
+                z, self.num_parts, self.scale_exp, self.ntips, None)
+            return clv, scaler, lnl
+
+        def impl(clv, scaler, chunk_data, dm, block_part, tips):
+            chunks = [fastpath.FastChunk(kind, width, *cd)
+                      for (kind, width), cd in zip(profile, chunk_data)]
+            return fastpath.run_chunks(dm, block_part, tips, clv, scaler,
+                                       chunks, self.scale_exp,
+                                       self.fast_precision)
+
+        fn = jax.jit(impl_eval if with_eval else impl, donate_argnums=(0, 1))
+        self._fast_jit_cache[key] = fn
+        return fn
 
     # -- evaluation --------------------------------------------------------
 
@@ -253,7 +333,7 @@ class LikelihoodEngine:
         """Per-partition lnL [M] at branch (p,q); CLVs must be current."""
         zv = jnp.asarray(_z_slots(z, self.num_branch_slots), dtype=self.dtype)
         out = self._jit_evaluate(self.clv, self.scaler,
-                                 jnp.int32(p_num - 1), jnp.int32(q_num - 1),
+                                 jnp.int32(self._gidx(p_num)), jnp.int32(self._gidx(q_num)),
                                  zv, self.models, self.block_part,
                                  self.weights, self.tips, self.site_rates)
         return np.asarray(out)
@@ -274,12 +354,26 @@ class LikelihoodEngine:
         return clv, scaler, lnl
 
     def traverse_evaluate(self, entries: List[TraversalEntry], p_num: int,
-                          q_num: int, z: Sequence[float]) -> np.ndarray:
+                          q_num: int, z: Sequence[float],
+                          full: bool = False) -> np.ndarray:
+        if full and entries and self._fast_eligible(entries):
+            sched = self._fast_schedule(entries)
+            fn = self._fast_fn(sched.profile, with_eval=True)
+            data = tuple((c.base, c.lidx, c.ridx, c.lcode, c.rcode,
+                          c.zl, c.zr) for c in sched.chunks)
+            self._install_row_map(sched)
+            zv = jnp.asarray(_z_slots(z, self.num_branch_slots),
+                             dtype=self.dtype)
+            self.clv, self.scaler, out = fn(
+                self.clv, self.scaler, data, jnp.int32(self._gidx(p_num)),
+                jnp.int32(self._gidx(q_num)), zv, self.models,
+                self.block_part, self.weights, self.tips)
+            return np.asarray(out)
         tv = self._traversal_arrays(entries)
         zv = jnp.asarray(_z_slots(z, self.num_branch_slots), dtype=self.dtype)
         self.clv, self.scaler, out = self._jit_trav_eval(
-            self.clv, self.scaler, tv, jnp.int32(p_num - 1),
-            jnp.int32(q_num - 1), zv, self.models, self.block_part,
+            self.clv, self.scaler, tv, jnp.int32(self._gidx(p_num)),
+            jnp.int32(self._gidx(q_num)), zv, self.models, self.block_part,
             self.weights, self.tips, self.site_rates)
         return np.asarray(out)
 
@@ -304,8 +398,8 @@ class LikelihoodEngine:
         if conv_mask is None:
             conv_mask = np.zeros(C, dtype=bool)
         self.clv, self.scaler, z = self._jit_newton(
-            self.clv, self.scaler, tv, jnp.int32(p_num - 1),
-            jnp.int32(q_num - 1), jnp.asarray(z0),
+            self.clv, self.scaler, tv, jnp.int32(self._gidx(p_num)),
+            jnp.int32(self._gidx(q_num)), jnp.asarray(z0),
             jnp.full(C, maxiter, dtype=jnp.int32), jnp.asarray(conv_mask),
             self.models, self.block_part, self.weights, self.tips,
             self.site_rates)
@@ -342,8 +436,8 @@ class LikelihoodEngine:
         tv = self._traversal_arrays(entries)
         zv = jnp.asarray(_z_slots(z, self.num_branch_slots), dtype=self.dtype)
         out = self._jit_rate_scan(
-            self.tips, tv, jnp.int32(p_num - 1),
-            jnp.int32(q_num - 1), zv,
+            self.tips, tv, jnp.int32(self._gidx(p_num)),
+            jnp.int32(self._gidx(q_num)), zv,
             jnp.asarray(grid, dtype=self.dtype), self.models,
             self.block_part)
         return np.asarray(out)
@@ -362,8 +456,8 @@ class LikelihoodEngine:
 
     def make_sumtable(self, p_num: int, q_num: int) -> jax.Array:
         return self._jit_sumtable(self.clv, self.scaler,
-                                  jnp.int32(p_num - 1),
-                                  jnp.int32(q_num - 1), self.models,
+                                  jnp.int32(self._gidx(p_num)),
+                                  jnp.int32(self._gidx(q_num)), self.models,
                                   self.block_part, self.tips)
 
     def branch_derivatives(self, st: jax.Array, z: Sequence[float]):

@@ -1,0 +1,207 @@
+"""Fast full-traversal path: case-split wave chunks, MXU-shaped dots.
+
+The TPU-native re-architecture of the reference's newview inner loops
+(ExaML `newviewGenericSpecial.c:1263-1497` dispatch over TIP_TIP /
+TIP_INNER / INNER_INNER kernels, and the MIC backend's tip-product
+precompute `umpX`, `mic_native_dna.c:132-165`), driven by what the MXU
+and XLA actually reward (measured, tools/perf_lab.py):
+
+* Waves of independent entries are split by tip case and executed as a
+  statically unrolled sequence of chunks (no `lax.scan`), each chunk one
+  batched dot over its natural (power-of-two padded) width.
+* The per-rate P application is folded into ONE block-diagonal
+  [R*K, R*K] contraction per child — 4x fewer MXU row-streams than R
+  separate [K, K] dots at identical numerics (the blocks are exact).
+* Tip children never materialize CLVs: a per-chunk `ump[code, r, a] =
+  sum_k P[r,a,k] * tipvec[code,k]` table is contracted against one-hot
+  code vectors — tip state never touches HBM at CLV width.
+* Parents of one chunk occupy CONTIGUOUS rows of a wave-ordered CLV
+  arena, so every write is a `dynamic_update_slice` that XLA performs
+  in place — the `.at[].set` scatter inside scan was measured to copy
+  the whole CLV buffer every step (half the runtime).
+
+The engine caches the jitted chunk-runner per wave profile (the schedule
+itself is rebuilt per call — branch lengths change every traversal) and
+keeps a node->row map so the scan path (partial traversals during search)
+and this path share one arena.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from examl_tpu.ops import kernels
+from examl_tpu.tree.topology import Tree, TraversalEntry
+
+
+class FastChunk(NamedTuple):
+    """One case-homogeneous batch of independent newview entries.
+
+    kind: 0 = tip-tip, 1 = tip-inner (tip is always the left child),
+    2 = inner-inner.  Arrays are device-resident, width-padded.
+    """
+    kind: int
+    width: int
+    base: jax.Array         # scalar int32: first arena row written
+    lidx: jax.Array         # [W] arena row of left child (kind 2)
+    ridx: jax.Array         # [W] arena row of right child (kind 1, 2)
+    lcode: jax.Array        # [W] 0-based tip index of left child (kind 0, 1)
+    rcode: jax.Array        # [W] 0-based tip index of right child (kind 0)
+    zl: jax.Array           # [W, C]
+    zr: jax.Array           # [W, C]
+
+
+class FastSchedule(NamedTuple):
+    chunks: Tuple[FastChunk, ...]
+    row_of: Dict[int, int]      # node number -> arena row
+    profile: Tuple[Tuple[int, int], ...]   # ((kind, width), ...) jit key
+    num_rows: int               # rows actually holding real entries
+    max_write: int              # highest row index written + 1 (incl. spill)
+
+
+def _pow2(n: int) -> int:
+    p = 1
+    while p < n:
+        p *= 2
+    return p
+
+
+def build_schedule(entries: List[TraversalEntry], ntips: int,
+                   num_slots: int, dtype, base_row: int = 0,
+                   row_of_existing: Dict[int, int] | None = None,
+                   ) -> FastSchedule:
+    """Wave-schedule entries into case-split chunks writing rows
+    base_row, base_row+1, ... in wave order.
+
+    row_of_existing resolves inner children computed OUTSIDE these
+    entries (partial traversals); full traversals need none.
+    """
+    from examl_tpu.utils import z_slots
+
+    waves = Tree.schedule_waves(entries)
+    row_of: Dict[int, int] = {}
+    lookup = row_of_existing or {}
+
+    def child_row(num: int) -> int:
+        if num in row_of:
+            return row_of[num]
+        return lookup[num]
+
+    chunks: List[FastChunk] = []
+    rows = base_row
+    max_write = base_row
+    for wave in waves:
+        def ntip(e):
+            return (e.left <= ntips) + (e.right <= ntips)
+        groups = ([e for e in wave if ntip(e) == 2],
+                  [e for e in wave if ntip(e) == 1],
+                  [e for e in wave if ntip(e) == 0])
+        base = rows
+        for wi, e in enumerate(groups[0] + groups[1] + groups[2]):
+            row_of[e.parent] = base + wi
+        off = 0
+        for kind, grp in ((0, groups[0]), (1, groups[1]), (2, groups[2])):
+            if not grp:
+                continue
+            W = _pow2(len(grp))
+            lidx = np.zeros(W, np.int32)
+            ridx = np.zeros(W, np.int32)
+            lcode = np.zeros(W, np.int32)
+            rcode = np.zeros(W, np.int32)
+            zl = np.ones((W, num_slots))
+            zr = np.ones((W, num_slots))
+            for wi, e in enumerate(grp):
+                lt, rt = e.left <= ntips, e.right <= ntips
+                ezl, ezr = e.zl, e.zr
+                el, er = e.left, e.right
+                if not lt and rt:      # canonicalize: tip child on the left
+                    el, er, ezl, ezr = er, el, ezr, ezl
+                    lt, rt = True, False
+                lidx[wi] = 0 if lt else child_row(el)
+                ridx[wi] = 0 if rt else child_row(er)
+                lcode[wi] = el - 1 if lt else 0
+                rcode[wi] = er - 1 if rt else 0
+                zl[wi] = z_slots(ezl, num_slots)
+                zr[wi] = z_slots(ezr, num_slots)
+            chunks.append(FastChunk(
+                kind=kind, width=W, base=jnp.int32(base + off),
+                lidx=jnp.asarray(lidx), ridx=jnp.asarray(ridx),
+                lcode=jnp.asarray(lcode), rcode=jnp.asarray(rcode),
+                zl=jnp.asarray(zl, dtype), zr=jnp.asarray(zr, dtype)))
+            max_write = max(max_write, base + off + W)
+            off += len(grp)
+        rows = base + off
+    profile = tuple((c.kind, c.width) for c in chunks)
+    return FastSchedule(chunks=tuple(chunks), row_of=row_of,
+                        profile=profile, num_rows=rows, max_write=max_write)
+
+
+def run_chunks(models: kernels.DeviceModels, block_part: jax.Array,
+               tips: kernels.TipState, clv: jax.Array, scaler: jax.Array,
+               chunks, scale_exp: int, precision) -> Tuple[jax.Array, jax.Array]:
+    """Execute the chunk sequence (traced; shapes static per profile).
+
+    clv is [rows, B, lane, R, K]; writes spill up to width-1 junk rows
+    past each chunk's real entries — the arena reserves slack for the
+    final chunk and intermediate spill is overwritten by later chunks
+    before anything reads it.
+    """
+    rows, B, lane, R, K = clv.shape
+    RK = R * K
+    M = models.eign.shape[0]
+    C = tips.table.shape[0]
+    eyeR = jnp.eye(R, dtype=clv.dtype)
+    HI = jax.lax.Precision.HIGHEST
+
+    def tip_child(p, code):
+        # ump[w,m,c,(r a)] = sum_k tipvec[c,k] P[w,m,r,a,k]; contracted
+        # against exact one-hot code vectors (MIC umpX generalization).
+        W = code.shape[0]
+        ump = jnp.einsum("ck,wmrak->wmcra", tips.table, p, precision=HI)
+        ump = ump.reshape(W, M, C, RK)[:, block_part]       # [W,B,C,RK]
+        oh = jax.nn.one_hot(tips.codes[code], C, dtype=clv.dtype)
+        return jax.lax.dot_general(oh, ump,
+                                   (((3,), (2,)), ((0, 1), (0, 1))),
+                                   precision=precision)
+
+    def inner_child(p, idx, clv):
+        # block-diagonal (r,k)->(r,a) contraction: exact same arithmetic
+        # as per-rate P application, one MXU-friendly [RK,RK] dot.
+        W = idx.shape[0]
+        pb = jnp.einsum("wmrak,rs->wmrksa", p, eyeR).reshape(W, M, RK, RK)
+        pb = pb[:, block_part]                              # [W,B,RK,RK]
+        x = clv[idx].reshape(W, B, lane, RK)
+        return jax.lax.dot_general(x, pb,
+                                   (((3,), (2,)), ((0, 1), (0, 1))),
+                                   precision=precision)
+
+    minlik, two_e, _ = kernels.scale_constants(clv.dtype, scale_exp)
+    for ch in chunks:
+        pl = kernels.p_matrices_wave(models, ch.zl)         # [W,M,R,K,K]
+        pr = kernels.p_matrices_wave(models, ch.zr)
+        W = ch.width
+        if ch.kind == 0:
+            yl = tip_child(pl, ch.lcode)
+            yr = tip_child(pr, ch.rcode)
+            sc = jnp.zeros((W, B, lane), jnp.int32)
+        elif ch.kind == 1:
+            yl = tip_child(pl, ch.lcode)
+            yr = inner_child(pr, ch.ridx, clv)
+            sc = scaler[ch.ridx]
+        else:
+            yl = inner_child(pl, ch.lidx, clv)
+            yr = inner_child(pr, ch.ridx, clv)
+            sc = scaler[ch.lidx] + scaler[ch.ridx]
+        v = yl * yr                                         # [W,B,lane,RK]
+        needs = jnp.max(jnp.abs(v), axis=3) < minlik
+        v = jnp.where(needs[..., None], v * two_e, v)
+        sc = sc + needs.astype(jnp.int32)
+        z0 = jnp.zeros((), ch.base.dtype)
+        clv = jax.lax.dynamic_update_slice(
+            clv, v.reshape(W, B, lane, R, K), (ch.base, z0, z0, z0, z0))
+        scaler = jax.lax.dynamic_update_slice(scaler, sc, (ch.base, z0, z0))
+    return clv, scaler

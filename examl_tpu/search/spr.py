@@ -367,14 +367,13 @@ def dfs_slot_order(tree: Tree) -> List[Node]:
     `nodeRectifier`/`reorderNodes`, `trash.c:21-74`, which re-points the
     nodep table at the DFS-entry slot of each inner node)."""
     inner: List[Node] = []
-
-    def rec(s: Node) -> None:
+    stack = [tree.start.back]
+    while stack:                      # iterative: must scale past the
+        s = stack.pop()               # recursion limit (SURVEY §6, ~120k taxa)
         if tree.is_tip(s.number):
-            return
+            continue
         inner.append(s)
-        rec(s.next.back)
-        rec(s.next.next.back)
-
-    rec(tree.start.back)
+        stack.append(s.next.next.back)
+        stack.append(s.next.back)
     tips = [tree.nodep[i] for i in range(1, tree.ntips + 1)]
     return tips + inner

@@ -131,19 +131,34 @@ class Tree:
         tree = cls(len(taxon_names), num_branches)
 
         def build(nw: NewickNode) -> Node:
-            """Return the slot representing subtree nw, to be hooked upward."""
-            if nw.is_leaf:
-                try:
-                    return tree.nodep[name_to_num[nw.name]]
-                except KeyError:
-                    raise ValueError(f"taxon {nw.name!r} not in alignment")
-            if len(nw.children) != 2:
-                raise ValueError("multifurcating inner node (resolve first)")
-            inner = tree.new_inner()
-            for slot, child in zip((inner.next, inner.next.next), nw.children):
-                sub = build(child)
-                hookup(slot, sub, _z_of(child, num_branches))
-            return inner
+            """Return the slot representing subtree nw, to be hooked upward.
+
+            Iterative post-order (results memoized by id) — reference-scale
+            trees exceed the recursion limit (SURVEY §6)."""
+            done: Dict[int, Node] = {}
+            stack: List[Tuple[NewickNode, bool]] = [(nw, False)]
+            while stack:
+                n, expanded = stack.pop()
+                if n.is_leaf:
+                    try:
+                        done[id(n)] = tree.nodep[name_to_num[n.name]]
+                    except KeyError:
+                        raise ValueError(f"taxon {n.name!r} not in alignment")
+                    continue
+                if len(n.children) != 2:
+                    raise ValueError(
+                        "multifurcating inner node (resolve first)")
+                if not expanded:
+                    stack.append((n, True))
+                    stack.extend((c, False) for c in n.children)
+                    continue
+                inner = tree.new_inner()
+                for slot, child in zip((inner.next, inner.next.next),
+                                       n.children):
+                    hookup(slot, done.pop(id(child)),
+                           _z_of(child, num_branches))
+                done[id(n)] = inner
+            return done[id(nw)]
 
         if len(root.children) != 3:
             raise ValueError("expected unrooted (trifurcating) tree after derooting")
@@ -195,22 +210,31 @@ class Tree:
         semantics (`newviewGenericSpecial.c:691-813`: children recurse only
         on `!x || !partialTraversal`, while p itself is appended
         unconditionally).
+
+        Iterative post-order (explicit stack): the reference ambition is
+        ~120k taxa (SURVEY §6), far beyond Python's recursion limit.
         """
         entries: List[TraversalEntry] = []
-
-        def rec(s: Node, top: bool = False) -> None:
+        # (slot, expanded?) — post-order via a two-visit stack.
+        stack: List[Tuple[Node, bool]] = [(p, False)]
+        top = True
+        while stack:
+            s, expanded = stack.pop()
             if self.is_tip(s.number):
-                return
+                continue
+            if expanded:
+                q = s.next.back
+                r = s.next.next.back
+                entries.append(
+                    TraversalEntry(s.number, q.number, r.number, q.z, r.z))
+                self.orient(s)
+                continue
             if not full and s.x and not top:
-                return
-            q = s.next.back
-            r = s.next.next.back
-            rec(q)
-            rec(r)
-            entries.append(TraversalEntry(s.number, q.number, r.number, q.z, r.z))
-            self.orient(s)
-
-        rec(p, top=True)
+                continue
+            top = False
+            stack.append((s, True))
+            stack.append((s.next.next.back, False))
+            stack.append((s.next.back, False))
         return entries
 
     @staticmethod
@@ -239,6 +263,62 @@ class Tree:
         p = self.start.back
         entries = self.compute_traversal(p, full=True)
         return p, entries
+
+    def centroid_branch(self) -> Node:
+        """A slot on the topological center branch of the tree.
+
+        Rooting full traversals here minimizes the dependency depth of the
+        wave schedule (≈ tree radius instead of height from an arbitrary
+        tip), which on TPU sets the number of sequential newview steps —
+        the analogue of picking a good virtual root, a freedom the
+        reference's strictly sequential `newviewIterative` never needed.
+        Classic double-BFS: the middle edge of a diameter path.
+        """
+        from collections import deque
+
+        def bfs(src: Node):
+            # Walk slots; returns (farthest tip number, parents map by id).
+            dist = {src.number: 0}
+            prev: Dict[int, int] = {}
+            dq = deque([src])
+            far = src
+            while dq:
+                s = dq.popleft()
+                for slot in self.slots(s.number):
+                    nb = slot.back
+                    if nb is None or nb.number in dist:
+                        continue
+                    dist[nb.number] = dist[s.number] + 1
+                    prev[nb.number] = s.number
+                    if dist[nb.number] > dist[far.number]:
+                        far = self.nodep[nb.number]
+                    dq.append(self.nodep[nb.number])
+            return far, dist, prev
+
+        a, _, _ = bfs(self.start)
+        b, dist, prev = bfs(a)
+        # middle of the a->b path
+        path = [b.number]
+        while path[-1] != a.number:
+            path.append(prev[path[-1]])
+        mid = path[len(path) // 2]
+        mid_next = path[max(len(path) // 2 - 1, 0)]
+        # return the slot of `mid` whose back is `mid_next`
+        for slot in self.slots(mid):
+            if slot.back is not None and slot.back.number == mid_next:
+                return slot
+        return self.nodep[mid]
+
+    def full_traversal_centroid(self) -> Tuple[Node, List[TraversalEntry]]:
+        """Full traversal rooted at the centroid branch (minimum wave depth)."""
+        s = self.centroid_branch()
+        if self.is_tip(s.number):
+            s = s.back
+        self.invalidate_all()
+        entries = self.compute_traversal(s, full=True)
+        if not self.is_tip(s.back.number):
+            entries += self.compute_traversal(s.back, full=True)
+        return s, entries
 
     def reset_branches(self) -> None:
         """Set every branch back to the default length (reference
@@ -280,15 +360,20 @@ class Tree:
             return -np.log(min(max(z, ZMIN), ZMAX))
 
         def rec(slot: Node) -> NewickNode:
-            nw = NewickNode()
-            if self.is_tip(slot.number):
-                nw.name = taxon_names[slot.number - 1]
-            else:
-                for s in (slot.next, slot.next.next):
-                    child = rec(s.back)
-                    child.length = t_of(s.z[branch_index])
+            # Iterative post-order build (tree height can exceed the
+            # recursion limit at reference scale, SURVEY §6).
+            top = NewickNode()
+            stack = [(slot, top)]
+            while stack:
+                s, nw = stack.pop()
+                if self.is_tip(s.number):
+                    nw.name = taxon_names[s.number - 1]
+                    continue
+                for sl in (s.next, s.next.next):
+                    child = NewickNode(length=t_of(sl.z[branch_index]))
                     nw.children.append(child)
-            return nw
+                    stack.append((sl.back, child))
+            return top
 
         # Standard unrooted export: trifurcation at start.back with the
         # starting tip as one child (reference Tree2String starts at

@@ -1,0 +1,97 @@
+"""Fast full-traversal path (ops/fastpath.py) vs the scan path.
+
+The fast path relayouts CLV rows in wave order and executes case-split
+chunk dots; it must agree with the scan-based traversal bit-for-bit in
+f64 and stay consistent when partial (scan-path) traversals follow a
+fast full traversal — the mixed regime the SPR search runs in.
+"""
+
+import numpy as np
+import pytest
+
+from examl_tpu.instance import PhyloInstance
+from examl_tpu.io.alignment import build_alignment_data, load_alignment
+from examl_tpu.tree.topology import Tree
+
+from tests.conftest import TESTDATA
+from tests.oracle import oracle_lnl
+
+
+@pytest.fixture(scope="module")
+def data49():
+    return load_alignment(f"{TESTDATA}/49", f"{TESTDATA}/49.model")
+
+
+@pytest.fixture(scope="module")
+def tree49_text():
+    with open(f"{TESTDATA}/49.tree") as f:
+        return f.read()
+
+
+def _fresh(data, text, **kw):
+    inst = PhyloInstance(data, **kw)
+    return inst, inst.tree_from_newick(text)
+
+
+def test_fast_matches_scan(data49, tree49_text):
+    inst_f, tree = _fresh(data49, tree49_text)
+    lnl_fast = inst_f.evaluate(tree, full=True)
+    assert any(len(e._fast_jit_cache) > 0 for e in inst_f.engines.values()), \
+        "full evaluate did not take the fast path"
+
+    inst_s, tree_s = _fresh(data49, tree49_text)
+    for eng in inst_s.engines.values():
+        eng.fast_slack = 0          # force scan path
+    lnl_scan = inst_s.evaluate(tree_s, full=True)
+    assert lnl_fast == pytest.approx(lnl_scan, rel=1e-12, abs=1e-7)
+
+
+def test_partial_after_fast_full(data49, tree49_text):
+    """Partial traversals must resolve rows through the wave-order map."""
+    inst, tree = _fresh(data49, tree49_text)
+    lnl0 = inst.evaluate(tree, full=True)          # fast path, relayout
+    # Change one internal branch, then evaluate at it with partial
+    # traversals only (scan path through row_map).
+    p = None
+    for s, _ in tree.all_branches():
+        if not tree.is_tip(s.number) and not tree.is_tip(s.back.number):
+            p = s
+            break
+    new_z = [max(min(z * 0.8, 0.99), 1e-6) for z in p.z]
+    from examl_tpu.tree.topology import hookup
+    hookup(p, p.back, new_z)
+    lnl1 = inst.evaluate(tree, p)                  # partial, mixed layout
+    ref = oracle_lnl(tree, data49, inst.models)
+    assert lnl1 == pytest.approx(ref, rel=1e-9)
+    assert lnl1 != pytest.approx(lnl0, abs=1e-6)   # branch change took effect
+
+
+def test_centroid_traversal_equivalent(data49, tree49_text):
+    inst, tree = _fresh(data49, tree49_text)
+    lnl0 = inst.evaluate(tree, full=True)
+    s, entries = tree.full_traversal_centroid()
+    assert len(entries) == inst.alignment.ntaxa - 2
+    lnl_c = inst.evaluate(tree, s, full=True)
+    assert lnl_c == pytest.approx(lnl0, rel=1e-10)
+
+
+def test_fast_path_per_partition_branches(data49, tree49_text):
+    inst_f, tree = _fresh(data49, tree49_text, per_partition_branches=True)
+    lnl_fast = inst_f.evaluate(tree, full=True)
+    inst_s, tree_s = _fresh(data49, tree49_text, per_partition_branches=True)
+    for eng in inst_s.engines.values():
+        eng.fast_slack = 0
+    lnl_scan = inst_s.evaluate(tree_s, full=True)
+    assert lnl_fast == pytest.approx(lnl_scan, rel=1e-12, abs=1e-7)
+
+
+def test_fast_path_binary_and_small():
+    """2-state data and a minimal 4-taxon tree go through the fast path."""
+    names = ["a", "b", "c", "d"]
+    seqs = ["0101100110", "0111100110", "1101001100", "1100001101"]
+    ad = build_alignment_data(names, seqs, datatype_name="BIN")
+    inst = PhyloInstance(ad)
+    tree = inst.random_tree(0)
+    lnl = inst.evaluate(tree, full=True)
+    ref = oracle_lnl(tree, ad, inst.models)
+    assert lnl == pytest.approx(ref, rel=1e-10)

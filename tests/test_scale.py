@@ -1,0 +1,77 @@
+"""Host-bookkeeping scale hardening: no recursion limits, big-tree smoke.
+
+The reference's ambition is ~120k taxa (SURVEY §6, manual FAQ); the host
+side (tree build, traversal scheduling, newick I/O, SPR iteration order)
+must therefore be iterative.  5,000 taxa comfortably exceeds Python's
+default recursion limit via any per-level recursion.
+"""
+
+import numpy as np
+import pytest
+
+from examl_tpu.instance import PhyloInstance
+from examl_tpu.io.alignment import build_alignment_data
+from examl_tpu.io.newick import format_newick, parse_newick
+from examl_tpu.search.spr import dfs_slot_order
+from examl_tpu.tree.topology import Tree
+
+N = 5000
+
+
+@pytest.fixture(scope="module")
+def caterpillar_newick():
+    """Worst-case (maximum height) topology: fully unbalanced."""
+    parts = ["(t0:0.1,t1:0.1)"]
+    for i in range(2, N):
+        parts.append(f"(%s:0.1,t{i}:0.1)" % parts[-1])
+        parts.pop(-2)
+    return parts[-1] + ";"
+
+
+def test_newick_roundtrip_caterpillar(caterpillar_newick):
+    root = parse_newick(caterpillar_newick)
+    assert sum(1 for _ in root.leaves()) == N
+    text = format_newick(root)
+    root2 = parse_newick(text)
+    assert sum(1 for _ in root2.leaves()) == N
+
+
+def test_tree_build_traverse_5k(caterpillar_newick):
+    names = [f"t{i}" for i in range(N)]
+    tree = Tree.from_newick(caterpillar_newick, names)
+    _, entries = tree.full_traversal()
+    assert len(entries) == N - 2
+    waves = Tree.schedule_waves(entries)
+    assert sum(len(w) for w in waves) == N - 2
+    # centroid rooting must cut the wave depth roughly in half on a
+    # caterpillar
+    _, entries_c = tree.full_traversal_centroid()
+    assert len(entries_c) == N - 2
+    assert len(Tree.schedule_waves(entries_c)) <= len(waves) / 2 + 2
+    order = dfs_slot_order(tree)
+    assert len(order) == N + (N - 2)
+    text = tree.to_newick(names)
+    assert text.count(",") == N - 1
+
+
+def test_random_tree_5k():
+    names = [f"t{i}" for i in range(N)]
+    tree = Tree.random(names, seed=1)
+    _, entries = tree.full_traversal()
+    assert len(entries) == N - 2
+
+
+@pytest.mark.slow
+def test_small_lnl_on_1k_taxa():
+    """End-to-end device path on a 1,000-taxon synthetic alignment."""
+    n = 1000
+    rng = np.random.default_rng(0)
+    names = [f"t{i}" for i in range(n)]
+    bases = "ACGT"
+    seqs = ["".join(bases[b] for b in rng.integers(0, 4, 256))
+            for _ in range(n)]
+    ad = build_alignment_data(names, seqs)
+    inst = PhyloInstance(ad)
+    tree = inst.random_tree(0)
+    lnl = inst.evaluate(tree, full=True)
+    assert np.isfinite(lnl) and lnl < 0

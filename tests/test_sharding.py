@@ -1,0 +1,117 @@
+"""Multi-device correctness: 1-device vs 8-virtual-device bit compares.
+
+The reference is rank-count-invariant by construction — every rank holds
+the whole tree and only sites are distributed, so lnL and derivatives
+must not depend on the process count (`communication.c:120-182`,
+deterministic-reduction note `makenewzGenericSpecial.c:1241-1248`).
+These tests pin the same property on a `jax.sharding.Mesh`: an 8-way
+site-sharded instance must reproduce the unsharded instance's
+likelihoods, Newton-Raphson derivatives, optimized branch lengths, and a
+full SPR search cycle on the 8 virtual CPU devices provisioned by
+conftest.py.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from examl_tpu.instance import PhyloInstance
+from examl_tpu.io.alignment import load_alignment
+from examl_tpu.parallel.sharding import (default_site_sharding, make_mesh,
+                                         site_sharding)
+
+from tests.conftest import TESTDATA
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs 8 (virtual) devices")
+
+
+@pytest.fixture(scope="module")
+def data49():
+    return load_alignment(f"{TESTDATA}/49", f"{TESTDATA}/49.model")
+
+
+@pytest.fixture(scope="module")
+def tree49_text():
+    with open(f"{TESTDATA}/49.tree") as f:
+        return f.read()
+
+
+def _pair(data, text):
+    """(unsharded instance, 8-way sharded instance) on identical input."""
+    sh = default_site_sharding(8)
+    inst1 = PhyloInstance(data)
+    inst8 = PhyloInstance(data, block_multiple=8, sharding=sh)
+    return (inst1, inst1.tree_from_newick(text),
+            inst8, inst8.tree_from_newick(text))
+
+
+def test_sharded_lnl_matches_unsharded(data49, tree49_text):
+    inst1, tree1, inst8, tree8 = _pair(data49, tree49_text)
+    lnl1 = inst1.evaluate(tree1, full=True)
+    lnl8 = inst8.evaluate(tree8, full=True)
+    # Same math, different block padding/summation grouping: f64 agreement
+    # far below any decision threshold of the search.
+    assert lnl8 == pytest.approx(lnl1, rel=1e-12, abs=1e-7)
+    # Verify the CLV tensor really is distributed over 8 devices.
+    eng = next(iter(inst8.engines.values()))
+    assert len(eng.clv.sharding.device_set) == 8
+
+
+def test_sharded_derivatives_match(data49, tree49_text):
+    inst1, tree1, inst8, tree8 = _pair(data49, tree49_text)
+    inst1.evaluate(tree1, full=True)
+    inst8.evaluate(tree8, full=True)
+    for (inst, tree) in ((inst1, tree1), (inst8, tree8)):
+        p = tree.nodep[tree.ntips + 3]
+        inst.new_view(tree, p)
+        inst.new_view(tree, p.back)
+    p1 = tree1.nodep[tree1.ntips + 3]
+    p8 = tree8.nodep[tree8.ntips + 3]
+    d1 = []
+    for inst, p in ((inst1, p1), (inst8, p8)):
+        eng = next(iter(inst.engines.values()))
+        st = eng.make_sumtable(p.number, p.back.number)
+        d1.append(eng.branch_derivatives(st, p.z))
+    (a1, a2), (b1, b2) = d1
+    np.testing.assert_allclose(a1, b1, rtol=1e-9)
+    np.testing.assert_allclose(a2, b2, rtol=1e-9)
+
+
+def test_sharded_newton_branch_matches(data49, tree49_text):
+    inst1, tree1, inst8, tree8 = _pair(data49, tree49_text)
+    inst1.evaluate(tree1, full=True)
+    inst8.evaluate(tree8, full=True)
+    z1 = inst1.makenewz(tree1, tree1.nodep[5], tree1.nodep[5].back,
+                        tree1.nodep[5].z, maxiter=16)
+    z8 = inst8.makenewz(tree8, tree8.nodep[5], tree8.nodep[5].back,
+                        tree8.nodep[5].z, maxiter=16)
+    np.testing.assert_allclose(z1, z8, rtol=1e-10)
+
+
+def test_sharded_spr_cycle(data49, tree49_text):
+    """One lazy SPR rearrangement cycle must pick the same moves sharded."""
+    from examl_tpu.search.raxml_search import tree_optimize_rapid
+    from examl_tpu.search.snapshots import BestList, InfoList
+    from examl_tpu.search.spr import SprContext
+
+    inst1, tree1, inst8, tree8 = _pair(data49, tree49_text)
+    out = []
+    for inst, tree in ((inst1, tree1), (inst8, tree8)):
+        inst.evaluate(tree, full=True)
+        ctx = SprContext(inst)
+        bt = BestList(20)
+        ilist = InfoList(50)
+        tree_optimize_rapid(inst, tree, ctx, 1, 5, bt, None, ilist)
+        inst.evaluate(tree, full=True)
+        out.append((inst.likelihood, tree.to_newick(
+            inst.alignment.taxon_names, with_lengths=False)))
+    (l1, n1), (l8, n8) = out
+    assert n1 == n8, "sharded SPR cycle chose a different topology"
+    assert l8 == pytest.approx(l1, rel=1e-10, abs=1e-5)
+
+
+def test_mesh_shapes():
+    mesh = make_mesh(n_devices=8)
+    sh = site_sharding(mesh)
+    assert sh.num_devices == 8

@@ -1,0 +1,205 @@
+"""Perf lab: measure newview-path variants on the real chip.
+
+Not part of the package — a measurement harness for the performance work
+(VERDICT round 2, item 1).  Each experiment times 50 dependency-chained
+full-tree traversals of testData/140 (the bench.py metric) under one
+structural variant, so changes can be evaluated one at a time.
+"""
+
+from __future__ import annotations
+
+import functools
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+jax.config.update("jax_enable_x64", True)
+
+from examl_tpu.instance import default_instance
+from examl_tpu.ops import kernels
+from examl_tpu.tree.topology import Tree
+
+DATA = "/root/reference/testData"
+N_STEPS = 50
+
+
+def timed(fn, *args):
+    out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    out = fn(*args)
+    jax.block_until_ready(out)
+    return time.perf_counter() - t0
+
+
+def report(name, dt, entries, patterns, rates, states):
+    ups = N_STEPS * entries * patterns * rates * states / dt
+    print(f"{name:42s} {dt/N_STEPS*1e3:8.3f} ms/trav  {ups/1e9:8.2f} Gup/s"
+          f"  vs_avx={ups/2.552e9:6.2f}")
+
+
+def main():
+    inst = default_instance(f"{DATA}/140", f"{DATA}/140.model")
+    tree = inst.tree_from_newick(open(f"{DATA}/140.tree").read())
+    eng = inst.engines[20]
+    _, entries = tree.full_traversal()
+    patterns = sum(p.width for p in inst.alignment.partitions)
+    E, R, K = len(entries), eng.R, eng.K
+    rep = functools.partial(report, entries=E, patterns=patterns,
+                            rates=R, states=K)
+
+    def chained(traverse_fn, clv, scaler):
+        def body(_, cs):
+            return traverse_fn(cs[0], cs[1])
+        return jax.lax.fori_loop(0, N_STEPS, body, (clv, scaler))[1].sum()
+
+    # -- A: baseline (current engine path, W=8, HIGHEST) --------------------
+    tv8 = eng._traversal_arrays(entries)
+    f = jax.jit(lambda c, s: chained(
+        lambda c2, s2: kernels.traverse(eng.models, eng.block_part, eng.tips,
+                                        c2, s2, tv8, eng.scale_exp, eng.ntips),
+        c, s))
+    rep("A baseline W=8 HIGHEST", timed(f, eng.clv, eng.scaler))
+
+    # -- precision variants on the same structure ---------------------------
+    for prec, tag in ((jax.lax.Precision.HIGH, "HIGH"),
+                      (jax.lax.Precision.DEFAULT, "DEFAULT")):
+        old = kernels.einsum
+        kernels.einsum = functools.partial(jnp.einsum, precision=prec)
+        try:
+            f = jax.jit(lambda c, s: chained(
+                lambda c2, s2: kernels.traverse(
+                    eng.models, eng.block_part, eng.tips, c2, s2, tv8,
+                    eng.scale_exp, eng.ntips), c, s))
+            rep(f"B W=8 {tag}", timed(f, eng.clv, eng.scaler))
+        finally:
+            kernels.einsum = old
+
+    # -- wave width variants ------------------------------------------------
+    for W in (16, 32, 64):
+        eng.wave_width = W
+        tvW = eng._traversal_arrays(entries)
+        f = jax.jit(lambda c, s, tvW=tvW: chained(
+            lambda c2, s2: kernels.traverse(
+                eng.models, eng.block_part, eng.tips, c2, s2, tvW,
+                eng.scale_exp, eng.ntips), c, s))
+        rep(f"C W={W} HIGHEST (L={tvW.parent.shape[0]})",
+            timed(f, eng.clv, eng.scaler))
+    eng.wave_width = 8
+
+    # -- D: W=32 + HIGH -----------------------------------------------------
+    eng.wave_width = 32
+    tv32 = eng._traversal_arrays(entries)
+    eng.wave_width = 8
+    old = kernels.einsum
+    kernels.einsum = functools.partial(jnp.einsum,
+                                       precision=jax.lax.Precision.HIGH)
+    try:
+        f = jax.jit(lambda c, s: chained(
+            lambda c2, s2: kernels.traverse(
+                eng.models, eng.block_part, eng.tips, c2, s2, tv32,
+                eng.scale_exp, eng.ntips), c, s))
+        rep("D W=32 HIGH", timed(f, eng.clv, eng.scaler))
+    finally:
+        kernels.einsum = old
+
+    # -- E: isolate the scatter: same compute, write to row 0 ---------------
+    tv0 = tv8._replace(parent=jnp.zeros_like(tv8.parent))
+    f = jax.jit(lambda c, s: chained(
+        lambda c2, s2: kernels.traverse(
+            eng.models, eng.block_part, eng.tips, c2, s2, tv0,
+            eng.scale_exp, eng.ntips), c, s))
+    rep("E W=8 scatter->row0 (invalid result)", timed(f, eng.clv, eng.scaler))
+
+    # -- F: matmul-only ceiling at each precision ---------------------------
+    # the two child P-applies, batch (W*L, B, R), no gather/scatter/scan.
+    WL = 27 * 8
+    x = jnp.ones((WL, 9, 128, R, K), jnp.float32)
+    p = jnp.ones((WL, 9, R, K, K), jnp.float32)
+    for prec, tag in ((jax.lax.Precision.HIGHEST, "HIGHEST"),
+                      (jax.lax.Precision.HIGH, "HIGH"),
+                      (jax.lax.Precision.DEFAULT, "DEFAULT")):
+        f = jax.jit(lambda x, p, prec=prec: jnp.einsum(
+            "wbrak,wblrk->wblra", p, x, precision=prec).sum())
+        dt = timed(f, x, p)
+        flops = 2 * WL * 9 * 128 * R * K * K
+        print(f"F einsum-only {tag:8s} {dt*1e3:8.3f} ms "
+              f"-> {flops/dt/1e12:6.2f} TFLOP/s")
+
+
+if __name__ == "__main__":
+    main()
+
+
+def blockdiag_variants():
+    """G: block-diagonal (rate,state) contraction newview formulation."""
+    inst = default_instance(f"{DATA}/140", f"{DATA}/140.model")
+    tree = inst.tree_from_newick(open(f"{DATA}/140.tree").read())
+    eng = inst.engines[20]
+    _, entries = tree.full_traversal()
+    patterns = sum(p.width for p in inst.alignment.partitions)
+    E, R, K = len(entries), eng.R, eng.K
+    rep = functools.partial(report, entries=E, patterns=patterns,
+                            rates=R, states=K)
+    ntips, scale_exp = eng.ntips, eng.scale_exp
+    eye = jnp.eye(R, dtype=eng.dtype)
+
+    def traverse_bd(tv, prec, clv, scaler):
+        models, block_part, tips = eng.models, eng.block_part, eng.tips
+
+        def body(carry, e):
+            clv, scaler = carry
+            parent, left, right, zl, zr = e
+            xl, sl = kernels.gather_child(tips, clv, scaler, left, ntips)
+            xr, sr = kernels.gather_child(tips, clv, scaler, right, ntips)
+            pl = kernels.p_matrices_wave(models, zl)[:, block_part]
+            pr = kernels.p_matrices_wave(models, zr)[:, block_part]
+            W_, B_, _, _, _ = pl.shape
+            # block-diag [W,B,RK,RA]
+            pbl = jnp.einsum("wbrak,rs->wbrksa", pl, eye).reshape(
+                W_, B_, R * K, R * K)
+            pbr = jnp.einsum("wbrak,rs->wbrksa", pr, eye).reshape(
+                W_, B_, R * K, R * K)
+            xl2 = xl.reshape(xl.shape[:3] + (R * K,))
+            xr2 = xr.reshape(xr.shape[:3] + (R * K,))
+            yl = jax.lax.dot_general(xl2, pbl, (((3,), (2,)), ((0, 1), (0, 1))),
+                                     precision=prec)
+            yr = jax.lax.dot_general(xr2, pbr, (((3,), (2,)), ((0, 1), (0, 1))),
+                                     precision=prec)
+            v = (yl * yr).reshape(xl.shape)
+            minlik, two_e, _ = kernels.scale_constants(v.dtype, scale_exp)
+            vmax = jnp.max(jnp.abs(v), axis=(3, 4))
+            needs = vmax < minlik
+            v = jnp.where(needs[:, :, :, None, None], v * two_e, v)
+            sc = sl + sr + needs.astype(jnp.int32)
+            clv = clv.at[parent].set(v)
+            scaler = scaler.at[parent].set(sc)
+            return (clv, scaler), None
+
+        (clv, scaler), _ = jax.lax.scan(
+            body, (clv, scaler), (tv.parent, tv.left, tv.right, tv.zl, tv.zr))
+        return clv, scaler
+
+    def chained(traverse_fn, clv, scaler):
+        def body(_, cs):
+            return traverse_fn(cs[0], cs[1])
+        return jax.lax.fori_loop(0, N_STEPS, body, (clv, scaler))[1].sum()
+
+    for W in (8, 16):
+        eng.wave_width = W
+        tv = eng._traversal_arrays(entries)
+        for prec, tag in ((jax.lax.Precision.HIGHEST, "HIGHEST"),
+                          (jax.lax.Precision.HIGH, "HIGH"),
+                          (jax.lax.Precision.DEFAULT, "DEFAULT")):
+            f = jax.jit(lambda c, s, tv=tv, prec=prec: chained(
+                lambda c2, s2: traverse_bd(tv, prec, c2, s2), c, s))
+            rep(f"G blockdiag W={W} {tag}", timed(f, eng.clv, eng.scaler))
+    eng.wave_width = 8
+
+
+if __name__ == "__main__":
+    import sys
+    if "-g" in sys.argv:
+        blockdiag_variants()
