@@ -37,7 +37,7 @@ class PhyloInstance:
         if self.psr:
             ncat = 1                      # one rate per site, weight 1
         self.psr_categories = psr_categories
-        self.save_memory = save_memory       # SEV mode: planned, accepted now
+        self.save_memory = save_memory       # SEV mode (ops/sev.py)
         self.alignment = alignment
         self.dtype = jnp.dtype(dtype if dtype is not None else default_dtype())
         self.ncat = ncat
@@ -94,7 +94,7 @@ class PhyloInstance:
                 bucket, [self.models[g] for g in bucket.part_ids],
                 alignment.ntaxa, num_branch_slots=self.num_branch_slots,
                 branch_indices=branch_indices, dtype=self.dtype,
-                sharding=sharding, psr=self.psr)
+                sharding=sharding, psr=self.psr, save_memory=save_memory)
 
         # PSR per-site rate state (reference patrat / rateCategory /
         # perSiteRates, `axml.h:585-600`): host copies per partition.

@@ -90,10 +90,17 @@ class LikelihoodEngine:
                  branch_indices: Optional[Sequence[int]] = None,
                  dtype=jnp.float64, sharding=None,
                  scale_exp: Optional[int] = None, wave_width: int = 8,
-                 psr: bool = False):
+                 psr: bool = False, save_memory: bool = False):
         self.bucket = bucket
         self.ntips = ntips
         self.psr = psr
+        self.save_memory = save_memory
+        if save_memory and psr:
+            raise ValueError("-S (SEV) is not supported under PSR "
+                             "(the reference likewise restricts -S)")
+        if save_memory and sharding is not None:
+            raise ValueError("-S (SEV) pool indirection does not compose "
+                             "with site-axis sharding yet")
         self.dtype = jnp.dtype(dtype)
         self.scale_exp = (scale_exp if scale_exp is not None
                           else kernels.default_scale_exponent(self.dtype))
@@ -110,7 +117,8 @@ class LikelihoodEngine:
         # traversals update rows in place through the map.  The arena keeps
         # `fast_slack` rows of headroom for the fast path's padded writes.
         self.n_inner = max(ntips - 2, 1)
-        self.fast_slack = 0 if psr else min(64, _next_pow2(ntips))
+        self.fast_slack = (0 if psr or save_memory
+                           else min(64, _next_pow2(ntips)))
         self.num_rows = self.n_inner + self.fast_slack + 1
         self.scratch_row = self.num_rows - 1
         self.row_map = np.full(2 * ntips - 1, -1, dtype=np.int64)
@@ -141,8 +149,16 @@ class LikelihoodEngine:
             bucket.weights.reshape(B, lane), dtype=self.dtype)
 
         self.tips = self._build_tip_state()
-        self.clv = jnp.zeros((self.num_rows, B, lane, self.R, self.K),
-                             dtype=self.dtype)
+        if save_memory:
+            from examl_tpu.ops.sev import SevState
+            self.clv = None
+            self.sev = SevState(bucket.tip_codes, self._undetermined_code(),
+                                self.num_rows, B, lane, self.R, self.K,
+                                self.dtype)
+        else:
+            self.sev = None
+            self.clv = jnp.zeros((self.num_rows, B, lane, self.R, self.K),
+                                 dtype=self.dtype)
         self.scaler = jnp.zeros((self.num_rows, B, lane), dtype=jnp.int32)
         if sharding is not None:
             self.apply_sharding(sharding)
@@ -152,11 +168,8 @@ class LikelihoodEngine:
         # CLV/scaler buffers are donated: they are replaced by the outputs,
         # never read again.  site_rates rides along as a traced argument
         # (None on the GAMMA path).
-        self._jit_traverse = jax.jit(
-            lambda clv, scaler, tv, dm, block_part, tips, sr:
-                kernels.traverse(dm, block_part, tips, clv, scaler, tv,
-                                 self.scale_exp, self.ntips, sr),
-            donate_argnums=(0, 1))
+        self._jit_traverse = jax.jit(self._traverse_only_impl,
+                                     donate_argnums=(0, 1))
         self._jit_evaluate = jax.jit(self._evaluate_impl)
         self._jit_trav_eval = jax.jit(self._trav_eval_impl,
                                       donate_argnums=(0, 1))
@@ -167,14 +180,19 @@ class LikelihoodEngine:
 
     # -- construction helpers ---------------------------------------------
 
-    def _build_tip_state(self) -> kernels.TipState:
+    def _datatype(self):
         from examl_tpu import datatypes
         if self.K == 4:
-            dt = datatypes.DNA
-        elif self.K == 20:
-            dt = datatypes.AA
-        else:
-            dt = datatypes.BINARY
+            return datatypes.DNA
+        if self.K == 20:
+            return datatypes.AA
+        return datatypes.BINARY
+
+    def _undetermined_code(self) -> int:
+        return self._datatype().undetermined_code
+
+    def _build_tip_state(self) -> kernels.TipState:
+        dt = self._datatype()
         table = jnp.asarray(dt.tip_indicator_table(), dtype=self.dtype)
         codes = self.bucket.tip_codes.astype(np.uint8).reshape(
             self.ntips, self.B, self.lane)
@@ -264,10 +282,57 @@ class LikelihoodEngine:
                                        self.tips)
             self._install_row_map(sched)
             return
+        if self.save_memory:
+            self._sev_begin(entries)
         tv = self._traversal_arrays(entries)
-        self.clv, self.scaler = self._jit_traverse(
-            self.clv, self.scaler, tv, self.models, self.block_part,
+        buf, aux = self._state()
+        buf, self.scaler = self._jit_traverse(
+            buf, self.scaler, aux, tv, self.models, self.block_part,
             self.tips, self.site_rates)
+        self._set_buf(buf)
+
+    # -- engine state: dense CLV buffer or SEV pool -------------------------
+    # Every device program takes (buf, scaler, aux): dense aux = (),
+    # SEV aux = (slot_read, slot_write).  buf and scaler are donated; aux
+    # is not (the engine keeps the slot maps across calls).
+
+    def _sev_begin(self, entries: List[TraversalEntry]):
+        """Update gap/cell bookkeeping for a traversal and sync device."""
+        self.sev.update_for_entries(entries)
+        self.sev.sync()
+
+    def _state(self):
+        if self.save_memory:
+            if self.sev.pool is None:
+                self.sev.sync()
+            return self.sev.pool, (self.sev.slot_read, self.sev.slot_write)
+        return self.clv, ()
+
+    def _set_buf(self, buf) -> None:
+        if self.save_memory:
+            self.sev.pool = buf
+        else:
+            self.clv = buf
+
+    def _gather(self, buf, aux, scaler, idx, tips):
+        if self.save_memory:
+            return kernels.gather_child_pooled(tips, buf, aux[0], scaler,
+                                               idx, self.ntips)
+        return kernels.gather_child(tips, buf, scaler, idx, self.ntips)
+
+    def _traverse_kernel(self, buf, aux, scaler, tv, dm, block_part, tips,
+                         sr):
+        if self.save_memory:
+            return kernels.traverse_pooled(dm, block_part, tips, buf,
+                                           aux[0], aux[1], scaler, tv,
+                                           self.scale_exp, self.ntips, sr)
+        return kernels.traverse(dm, block_part, tips, buf, scaler, tv,
+                                self.scale_exp, self.ntips, sr)
+
+    def _traverse_only_impl(self, buf, scaler, aux, tv, dm, block_part,
+                            tips, sr):
+        return self._traverse_kernel(buf, aux, scaler, tv, dm, block_part,
+                                     tips, sr)
 
     # -- fast full-traversal path (ops/fastpath.py) ------------------------
 
@@ -323,17 +388,21 @@ class LikelihoodEngine:
 
     # -- evaluation --------------------------------------------------------
 
-    def _evaluate_impl(self, clv, scaler, p_idx, q_idx, z, dm, block_part,
-                       weights, tips, sr):
-        return kernels.root_log_likelihood(
-            dm, block_part, weights, tips, clv, scaler,
-            p_idx, q_idx, z, self.num_parts, self.scale_exp, self.ntips, sr)
+    def _evaluate_impl(self, buf, scaler, aux, p_idx, q_idx, z, dm,
+                       block_part, weights, tips, sr):
+        xp, sp = self._gather(buf, aux, scaler, p_idx, tips)
+        xq, sq = self._gather(buf, aux, scaler, q_idx, tips)
+        return kernels.root_log_likelihood_from(
+            dm, block_part, weights, xp, sp, xq, sq, z, self.num_parts,
+            self.scale_exp, sr)
 
     def evaluate(self, p_num: int, q_num: int, z: Sequence[float]) -> np.ndarray:
         """Per-partition lnL [M] at branch (p,q); CLVs must be current."""
         zv = jnp.asarray(_z_slots(z, self.num_branch_slots), dtype=self.dtype)
-        out = self._jit_evaluate(self.clv, self.scaler,
-                                 jnp.int32(self._gidx(p_num)), jnp.int32(self._gidx(q_num)),
+        buf, aux = self._state()
+        out = self._jit_evaluate(buf, self.scaler, aux,
+                                 jnp.int32(self._gidx(p_num)),
+                                 jnp.int32(self._gidx(q_num)),
                                  zv, self.models, self.block_part,
                                  self.weights, self.tips, self.site_rates)
         return np.asarray(out)
@@ -344,14 +413,13 @@ class LikelihoodEngine:
     # evaluateGeneric and one per NR iteration (SURVEY §3.2-3.3); here each
     # search step is a single dispatch.
 
-    def _trav_eval_impl(self, clv, scaler, tv, p_idx, q_idx, z, dm,
+    def _trav_eval_impl(self, buf, scaler, aux, tv, p_idx, q_idx, z, dm,
                         block_part, weights, tips, sr):
-        clv, scaler = kernels.traverse(dm, block_part, tips, clv, scaler,
-                                       tv, self.scale_exp, self.ntips, sr)
-        lnl = kernels.root_log_likelihood(
-            dm, block_part, weights, tips, clv, scaler, p_idx, q_idx, z,
-            self.num_parts, self.scale_exp, self.ntips, sr)
-        return clv, scaler, lnl
+        buf, scaler = self._traverse_kernel(buf, aux, scaler, tv, dm,
+                                            block_part, tips, sr)
+        lnl = self._evaluate_impl(buf, scaler, aux, p_idx, q_idx, z, dm,
+                                  block_part, weights, tips, sr)
+        return buf, scaler, lnl
 
     def traverse_evaluate(self, entries: List[TraversalEntry], p_num: int,
                           q_num: int, z: Sequence[float],
@@ -369,40 +437,48 @@ class LikelihoodEngine:
                 jnp.int32(self._gidx(q_num)), zv, self.models,
                 self.block_part, self.weights, self.tips)
             return np.asarray(out)
+        if self.save_memory:
+            self._sev_begin(entries)
         tv = self._traversal_arrays(entries)
         zv = jnp.asarray(_z_slots(z, self.num_branch_slots), dtype=self.dtype)
-        self.clv, self.scaler, out = self._jit_trav_eval(
-            self.clv, self.scaler, tv, jnp.int32(self._gidx(p_num)),
+        buf, aux = self._state()
+        buf, self.scaler, out = self._jit_trav_eval(
+            buf, self.scaler, aux, tv, jnp.int32(self._gidx(p_num)),
             jnp.int32(self._gidx(q_num)), zv, self.models, self.block_part,
             self.weights, self.tips, self.site_rates)
+        self._set_buf(buf)
         return np.asarray(out)
 
-    def _newton_impl(self, clv, scaler, tv, p_idx, q_idx, z0, maxiters,
-                     conv, dm, block_part, weights, tips, sr):
-        clv, scaler = kernels.traverse(dm, block_part, tips, clv, scaler,
-                                       tv, self.scale_exp, self.ntips, sr)
-        xp, _ = kernels.gather_child(tips, clv, scaler, p_idx, self.ntips)
-        xq, _ = kernels.gather_child(tips, clv, scaler, q_idx, self.ntips)
+    def _newton_impl(self, buf, scaler, aux, tv, p_idx, q_idx, z0,
+                     maxiters, conv, dm, block_part, weights, tips, sr):
+        buf, scaler = self._traverse_kernel(buf, aux, scaler, tv, dm,
+                                            block_part, tips, sr)
+        xp, _ = self._gather(buf, aux, scaler, p_idx, tips)
+        xq, _ = self._gather(buf, aux, scaler, q_idx, tips)
         st = kernels.sumtable(dm, block_part, xp, xq)
         z = kernels.newton_raphson_branch(dm, block_part, weights, st, z0,
                                           maxiters, conv,
                                           self.num_branch_slots, sr)
-        return clv, scaler, z
+        return buf, scaler, z
 
     def newton_branch(self, entries: List[TraversalEntry], p_num: int,
                       q_num: int, z0: np.ndarray, maxiter: int,
                       conv_mask: Optional[np.ndarray] = None) -> np.ndarray:
         """Fused traversal + sumtable + NR-to-convergence; returns new z [C]."""
+        if self.save_memory:
+            self._sev_begin(entries)
         tv = self._traversal_arrays(entries)
         C = self.num_branch_slots
         if conv_mask is None:
             conv_mask = np.zeros(C, dtype=bool)
-        self.clv, self.scaler, z = self._jit_newton(
-            self.clv, self.scaler, tv, jnp.int32(self._gidx(p_num)),
+        buf, aux = self._state()
+        buf, self.scaler, z = self._jit_newton(
+            buf, self.scaler, aux, tv, jnp.int32(self._gidx(p_num)),
             jnp.int32(self._gidx(q_num)), jnp.asarray(z0),
             jnp.full(C, maxiter, dtype=jnp.int32), jnp.asarray(conv_mask),
             self.models, self.block_part, self.weights, self.tips,
             self.site_rates)
+        self._set_buf(buf)
         return np.asarray(z, dtype=np.float64)
 
     # -- PSR rate-grid scan -------------------------------------------------
@@ -444,10 +520,10 @@ class LikelihoodEngine:
 
     # -- branch derivatives ------------------------------------------------
 
-    def _sumtable_impl(self, clv, scaler, p_idx, q_idx, dm, block_part,
-                       tips):
-        xp, _ = kernels.gather_child(tips, clv, scaler, p_idx, self.ntips)
-        xq, _ = kernels.gather_child(tips, clv, scaler, q_idx, self.ntips)
+    def _sumtable_impl(self, buf, scaler, aux, p_idx, q_idx, dm,
+                       block_part, tips):
+        xp, _ = self._gather(buf, aux, scaler, p_idx, tips)
+        xq, _ = self._gather(buf, aux, scaler, q_idx, tips)
         return kernels.sumtable(dm, block_part, xp, xq)
 
     def _derivs_impl(self, st, z, dm, block_part, weights, sr):
@@ -455,7 +531,8 @@ class LikelihoodEngine:
                                       st, z, self.num_branch_slots, sr)
 
     def make_sumtable(self, p_num: int, q_num: int) -> jax.Array:
-        return self._jit_sumtable(self.clv, self.scaler,
+        buf, aux = self._state()
+        return self._jit_sumtable(buf, self.scaler, aux,
                                   jnp.int32(self._gidx(p_num)),
                                   jnp.int32(self._gidx(q_num)), self.models,
                                   self.block_part, self.tips)

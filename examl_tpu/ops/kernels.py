@@ -267,6 +267,68 @@ def traverse(models: DeviceModels, block_part: jax.Array, tips: TipState,
     return clv, scaler
 
 
+def gather_child_pooled(tips: TipState, pool: jax.Array,
+                        slot_read: jax.Array, scaler: jax.Array,
+                        idx: jax.Array, ntips: int):
+    """SEV variant of `gather_child`: inner CLVs live in a block-cell pool.
+
+    pool: [S, lane, R, K]; slot_read: [rows, B] int32 mapping (row, block)
+    to a pool cell, with all-gap cells mapped to the shared constant
+    all-ones cell 0 — the TPU-native form of the reference's single shared
+    `gapColumn` CLV per node (`newviewGenericSpecial.c:139-160`).
+    """
+    R = pool.shape[2]
+    idx = jnp.asarray(idx)
+    is_tip = idx < ntips
+    tip_idx = jnp.clip(idx, 0, ntips - 1)
+    codes = tips.codes[tip_idx]                      # [..., B, lane]
+    tip_clv = tips.table[codes]                      # [..., B, lane, K]
+    tip_clv = jnp.broadcast_to(
+        tip_clv[..., :, :, None, :],
+        tip_clv.shape[:-1] + (R, tip_clv.shape[-1]))
+    row = jnp.clip(idx - ntips, 0, slot_read.shape[0] - 1)
+    cells = slot_read[row]                           # [..., B]
+    inner_clv = pool[cells]                          # [..., B, lane, R, K]
+    sel = is_tip[..., None, None, None, None]
+    x = jnp.where(sel, tip_clv, inner_clv)
+    sc = jnp.where(is_tip[..., None, None], 0, scaler[row])
+    return x, sc
+
+
+def traverse_pooled(models: DeviceModels, block_part: jax.Array,
+                    tips: TipState, pool: jax.Array, slot_read: jax.Array,
+                    slot_write: jax.Array, scaler: jax.Array,
+                    tv: Traversal, scale_exp: int, ntips: int,
+                    site_rates=None):
+    """SEV traversal: like `traverse`, but CLV cells live in the pool.
+
+    slot_write maps all-gap (row, block) cells to a scratch cell whose
+    content is never read; their value is the constant cell 0 on the read
+    side, so all-gap subtrees cost one shared cell of memory — the
+    reference's `-S` design (`axml.c:2152-2171`, `_GAPPED_SAVE` kernels)
+    re-expressed as static-shape pool indirection.
+    """
+    def body(carry, e):
+        pool, scaler = carry
+        parent, left, right, zl, zr = e
+        xl, sl = gather_child_pooled(tips, pool, slot_read, scaler, left,
+                                     ntips)
+        xr, sr = gather_child_pooled(tips, pool, slot_read, scaler, right,
+                                     ntips)
+        v, inc = newview_wave(models, block_part, xl, xr,
+                              zl, zr, scale_exp, site_rates)
+        sc = sl + sr + inc                               # [W, B, lane]
+        cells = slot_write[parent]                       # [W, B]
+        pool = pool.at[cells].set(v, unique_indices=False)
+        scaler = scaler.at[parent].set(sc, unique_indices=False)
+        return (pool, scaler), None
+
+    (pool, scaler), _ = jax.lax.scan(
+        body, (pool, scaler),
+        (tv.parent, tv.left, tv.right, tv.zl, tv.zr))
+    return pool, scaler
+
+
 def site_likelihoods(models: DeviceModels, block_part: jax.Array,
                      xp: jax.Array, xq: jax.Array, z: jax.Array,
                      site_rates=None):
@@ -325,6 +387,16 @@ def root_log_likelihood(models: DeviceModels, block_part: jax.Array,
     """
     xp, sp = gather_child(tips, clv, scaler, p_idx, ntips)
     xq, sq = gather_child(tips, clv, scaler, q_idx, ntips)
+    return root_log_likelihood_from(models, block_part, weights, xp, sp,
+                                    xq, sq, z, num_parts, scale_exp,
+                                    site_rates)
+
+
+def root_log_likelihood_from(models: DeviceModels, block_part: jax.Array,
+                             weights: jax.Array, xp, sp, xq, sq,
+                             z: jax.Array, num_parts: int, scale_exp: int,
+                             site_rates=None):
+    """root_log_likelihood over pre-gathered root CLVs (pooled/SEV path)."""
     lsite = site_likelihoods(models, block_part, xp, xq, z, site_rates)
     acc = _acc_dtype(lsite.dtype)
     _, _, log_min = scale_constants(acc, scale_exp)

@@ -1,0 +1,133 @@
+"""SEV memory saving (`-S`): block-granular CLV pool with gap sharing.
+
+Reference design (`-S`, SURVEY §5.7): per-node gap bit-vectors, CLVs
+allocated only for non-gap sites, and one shared `gapColumn` CLV per node
+for all-gap sites (`axml.c:2152-2171`, `newviewGenericSpecial.c:139-160`,
+`_GAPPED_SAVE` kernel variants; 70 GB -> 19 GB claim `axml.c:874-876`).
+
+TPU-native re-design: data-dependent per-node CLV lengths are hostile to
+XLA's static shapes, so the saving is expressed as INDIRECTION at 128-site
+block granularity instead of per-site compaction.  A (node row, block)
+cell whose subtree is all-gap in that block is not stored: reads map it to
+one shared constant all-ones cell (an all-gap subtree's CLV is exactly 1:
+P(z) rows sum to 1, and products of ones stay ones, never rescaled);
+writes map it to a scratch cell.  Real cells live in a flat pool
+`[S, lane, R, K]` that grows on demand; the host tracks per-node gap
+bitsets (AND of the children's, updated with every traversal it builds,
+the reference's in-kernel `x3_gap = x1_gap & x2_gap`) and a free list, so
+topology changes reallocate only the recomputed nodes' cells.
+
+Zero-weight padding blocks are all-gap for every tip, so SEV also stops
+paying for lane padding.  Granularity note: a block with ANY non-gap site
+is stored whole — the reference compacts per site, so its ratio is better
+on alignments whose gaps do not align to 128-column runs; block
+granularity is what keeps every shape static for XLA.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import jax.numpy as jnp
+import numpy as np
+
+from examl_tpu.tree.topology import TraversalEntry
+
+ONES_CELL = 0      # shared constant all-ones cell (read target of gap cells)
+SCRATCH_CELL = 1   # write target of gap cells; content never read
+FIRST_DATA_CELL = 2
+
+
+class SevState:
+    """Host bookkeeping + device arrays for one engine's CLV pool."""
+
+    def __init__(self, tip_codes: np.ndarray, undetermined_code: int,
+                 num_rows: int, B: int, lane: int, R: int, K: int, dtype):
+        self.B, self.lane, self.R, self.K = B, lane, R, K
+        self.dtype = dtype
+        ntips = tip_codes.shape[0]
+        codes = tip_codes.reshape(ntips, B, lane)
+        self.tip_gap = (codes == undetermined_code).all(axis=2)  # [ntips, B]
+        self.ntips = ntips
+        self.num_rows = num_rows
+        self.node_gap = np.ones((num_rows, B), dtype=bool)
+        self.cell_of = np.full((num_rows, B), -1, dtype=np.int64)
+        self.free: List[int] = []
+        self.next_cell = FIRST_DATA_CELL
+        self.cap = 0
+        self.pool = None                      # device [S, lane, R, K]
+        self.slot_read = None                 # device [num_rows, B] int32
+        self.slot_write = None
+        self.dirty = True
+
+    # -- gap bookkeeping ----------------------------------------------------
+
+    def _gap_of(self, num: int) -> np.ndarray:
+        if num <= self.ntips:
+            return self.tip_gap[num - 1]
+        return self.node_gap[num - self.ntips - 1]
+
+    def update_for_entries(self, entries: List[TraversalEntry]) -> None:
+        """Refresh gap bits + cell allocations for nodes about to be
+        recomputed (post-order, so children update before parents)."""
+        for e in entries:
+            row = e.parent - self.ntips - 1
+            g = self._gap_of(e.left) & self._gap_of(e.right)
+            need = ~g
+            have = self.cell_of[row] >= 0
+            if not np.array_equal(need, have):
+                self.dirty = True
+                drop = have & ~need
+                if drop.any():
+                    self.free.extend(int(c) for c in self.cell_of[row][drop])
+                    self.cell_of[row][drop] = -1
+                grow = need & ~have
+                n = int(grow.sum())
+                if n:
+                    self.cell_of[row][grow] = self._alloc(n)
+            self.node_gap[row] = g
+
+    def _alloc(self, n: int) -> np.ndarray:
+        out = np.empty(n, dtype=np.int64)
+        take = min(n, len(self.free))
+        for i in range(take):
+            out[i] = self.free.pop()
+        for i in range(take, n):
+            out[i] = self.next_cell
+            self.next_cell += 1
+        return out
+
+    # -- device sync ---------------------------------------------------------
+
+    def sync(self) -> None:
+        """Grow the pool if needed and re-upload slot maps if changed."""
+        if self.pool is None or self.next_cell > self.cap:
+            new_cap = max(64, int(self.next_cell * 1.3) + 8)
+            new_pool = jnp.zeros((new_cap, self.lane, self.R, self.K),
+                                 dtype=self.dtype)
+            new_pool = new_pool.at[ONES_CELL].set(1.0)
+            if self.pool is not None:
+                new_pool = new_pool.at[:self.cap].set(self.pool)
+            self.pool = new_pool
+            self.cap = new_cap
+        if self.dirty:
+            self.slot_read = jnp.asarray(
+                np.where(self.cell_of >= 0, self.cell_of,
+                         ONES_CELL).astype(np.int32))
+            self.slot_write = jnp.asarray(
+                np.where(self.cell_of >= 0, self.cell_of,
+                         SCRATCH_CELL).astype(np.int32))
+            self.dirty = False
+
+    # -- reporting ------------------------------------------------------------
+
+    def stats(self) -> dict:
+        allocated = self.next_cell - FIRST_DATA_CELL - len(self.free)
+        dense = self.num_rows * self.B
+        return {
+            "allocated_cells": int(allocated),
+            "dense_cells": int(dense),
+            "cell_bytes": int(self.lane * self.R * self.K
+                              * jnp.dtype(self.dtype).itemsize),
+            "saving_ratio": 1.0 - allocated / max(dense, 1),
+        }

@@ -1,0 +1,155 @@
+"""SEV (-S) memory saving: pooled CLV cells vs the dense engine.
+
+Reference behavior being matched: `-S` gappy-column memory saving
+(`axml.c:874-876` 70->19 GB claim; mechanism `axml.c:2152-2171`,
+`newviewGenericSpecial.c:139-160`).  The TPU design shares one constant
+cell for all (node, block) cells whose subtree is all-gap in that block
+(ops/sev.py), so a gene-concatenation where each gene covers a taxon
+subset must (1) reproduce the dense engine's lnL exactly and (2) allocate
+far fewer CLV cells than the dense layout.
+"""
+
+import numpy as np
+import pytest
+
+from examl_tpu.instance import PhyloInstance
+from examl_tpu.io.alignment import build_alignment_data
+from examl_tpu.tree.topology import hookup
+
+
+def _gappy_alignment(ntaxa=24, genes=3, gene_sites=384, seed=0):
+    """Concatenation of `genes` genes; gene g covers only taxa in its
+    third of the taxon set, everyone else is all-gap there."""
+    rng = np.random.default_rng(seed)
+    names = [f"t{i}" for i in range(ntaxa)]
+    per = ntaxa // genes
+    seqs = ["" for _ in range(ntaxa)]
+    parts = []
+    pos = 1
+    for g in range(genes):
+        covered = range(g * per, (g + 1) * per)
+        for i in range(ntaxa):
+            if i in covered:
+                seqs[i] += "".join("ACGT"[b]
+                                   for b in rng.integers(0, 4, gene_sites))
+            else:
+                seqs[i] += "-" * gene_sites
+        parts.append(f"DNA, gene{g} = {pos}-{pos + gene_sites - 1}")
+        pos += gene_sites
+    return names, seqs, "\n".join(parts)
+
+
+@pytest.fixture(scope="module")
+def gappy():
+    names, seqs, model_text = _gappy_alignment()
+    import tempfile, os
+    d = tempfile.mkdtemp()
+    mp = os.path.join(d, "parts.model")
+    with open(mp, "w") as f:
+        f.write(model_text + "\n")
+    from examl_tpu.io.partitions import parse_partition_file
+    return build_alignment_data(names, seqs,
+                                specs=parse_partition_file(mp))
+
+
+def test_sev_lnl_matches_dense(gappy):
+    dense = PhyloInstance(gappy)
+    sev = PhyloInstance(gappy, save_memory=True)
+    t1 = dense.random_tree(7)
+    t2 = sev.random_tree(7)
+    l1 = dense.evaluate(t1, full=True)
+    l2 = sev.evaluate(t2, full=True)
+    assert l2 == pytest.approx(l1, rel=1e-12, abs=1e-8)
+
+    stats = next(iter(sev.engines.values())).sev.stats()
+    assert stats["allocated_cells"] < stats["dense_cells"]
+    # each gene is all-gap for 2/3 of taxa; even a random topology (no
+    # gene monophyly) shares a fifth of the cells
+    assert stats["saving_ratio"] > 0.2, stats
+
+
+def test_sev_saving_on_gene_clades(gappy):
+    """When each gene's taxa form a clade (the realistic concatenation
+    shape), most inner nodes live inside one gene and the saving
+    approaches the 2/3 gappyness of the alignment."""
+    sev = PhyloInstance(gappy, save_memory=True)
+    per = 8
+    clades = []
+    for g in range(3):
+        names = [f"t{i}" for i in range(g * per, (g + 1) * per)]
+        c = names[0]
+        for n in names[1:]:
+            c = f"({c}:0.1,{n}:0.1)"
+        clades.append(c)
+    text = f"({clades[0]}:0.1,{clades[1]}:0.1,{clades[2]}:0.1);"
+    tree = sev.tree_from_newick(text)
+    lnl = sev.evaluate(tree, full=True)
+    assert np.isfinite(lnl) and lnl < 0
+    stats = next(iter(sev.engines.values())).sev.stats()
+    # CLV orientation roots at tip 1, so gene-1's clade path to the root
+    # is non-gap; the other two gene clades share their cells fully.
+    assert stats["saving_ratio"] > 0.4, stats
+
+
+def test_sev_partial_traversals_and_newton(gappy):
+    dense = PhyloInstance(gappy)
+    sev = PhyloInstance(gappy, save_memory=True)
+    t1 = dense.random_tree(3)
+    t2 = sev.random_tree(3)
+    dense.evaluate(t1, full=True)
+    sev.evaluate(t2, full=True)
+    # branch change + partial evaluate
+    for inst, tree in ((dense, t1), (sev, t2)):
+        p = tree.nodep[tree.ntips + 2]
+        hookup(p, p.back, [0.5] * len(p.z))
+    l1 = dense.evaluate(t1, t1.nodep[t1.ntips + 2])
+    l2 = sev.evaluate(t2, t2.nodep[t2.ntips + 2])
+    assert l2 == pytest.approx(l1, rel=1e-12, abs=1e-8)
+    # Newton-Raphson on a branch
+    z1 = dense.makenewz(t1, t1.nodep[5], t1.nodep[5].back,
+                        t1.nodep[5].z, maxiter=16)
+    z2 = sev.makenewz(t2, t2.nodep[5], t2.nodep[5].back,
+                      t2.nodep[5].z, maxiter=16)
+    np.testing.assert_allclose(z1, z2, rtol=1e-10)
+
+
+def test_sev_topology_change_reallocates(gappy):
+    """An SPR-style topology change must refresh gap bits and still match
+    the dense engine after the reallocation."""
+    dense = PhyloInstance(gappy)
+    sev = PhyloInstance(gappy, save_memory=True)
+    t1 = dense.random_tree(11)
+    t2 = sev.random_tree(11)
+    dense.evaluate(t1, full=True)
+    sev.evaluate(t2, full=True)
+
+    def nni(tree):
+        # swap two subtrees across an internal branch (a simple NNI)
+        for p, q in tree.all_branches():
+            if tree.is_tip(p.number) or tree.is_tip(q.number):
+                continue
+            a = p.next.back
+            b = q.next.back
+            az, bz = list(a.z), list(b.z)
+            hookup(p.next, b, bz)
+            hookup(q.next, a, az)
+            return
+    nni(t1)
+    nni(t2)
+    l1 = dense.evaluate(t1, full=True)
+    l2 = sev.evaluate(t2, full=True)
+    assert l2 == pytest.approx(l1, rel=1e-12, abs=1e-8)
+
+
+@pytest.mark.slow
+def test_sev_search_smoke(gappy):
+    """A short -f d style search runs under SEV and improves lnL."""
+    from examl_tpu.search.raxml_search import SearchOptions, compute_big_rapid
+    sev = PhyloInstance(gappy, save_memory=True)
+    tree = sev.random_tree(5)
+    start = sev.evaluate(tree, full=True)
+    res = compute_big_rapid(sev, tree,
+                            SearchOptions(initial=2, initial_set=True,
+                                          max_rearrange=4,
+                                          estimate_model=False))
+    assert res.likelihood > start
