@@ -214,8 +214,24 @@ def run_search(args, inst, files: RunFiles) -> int:
         log=log)
     conv = (RfConvergence(inst.alignment.ntaxa, log=files.info)
             if args.rf_convergence else None)
+    if conv is not None and resume is not None:
+        blob = resume.get("extras", {}).get("rf_history")
+        if blob:
+            conv.load_blob(blob)
+            files.info("restored RF-convergence history from checkpoint")
+    inner_cb = mgr.callback(inst, tree)
+
+    def checkpoint_cb(state: str, extras: dict) -> None:
+        # Persist the -D convergence evidence with every checkpoint so a
+        # restart keeps comparing against the pre-restart cycle's tree
+        # (reference restores this via stored newick strings,
+        # `restartHashTable.c:279-357`).
+        if conv is not None:
+            extras = dict(extras, rf_history=conv.to_blob())
+        inner_cb(state, extras)
+
     res = compute_big_rapid(inst, tree, opts, convergence_cb=conv,
-                            checkpoint_cb=mgr.callback(inst, tree),
+                            checkpoint_cb=checkpoint_cb,
                             resume=resume)
 
     files.info(f"Likelihood of best tree: {res.likelihood:.6f}")
@@ -235,28 +251,77 @@ def run_search(args, inst, files: RunFiles) -> int:
 
 def run_tree_evaluation(args, inst, files: RunFiles) -> int:
     """-f e / -f E: optimize model+branches on each tree in the file
-    (reference `optimizeTrees`, `axml.c:2251-2356`)."""
+    (reference `optimizeTrees`, `axml.c:2251-2356`), checkpointing with
+    the MOD_OPT state per optimizer round and per finished tree
+    (reference `axml.h:655-659`, restart dispatch `searchAlgo.c:1730-1749`
+    and the -f e checkpoint leg `axml.c:2276-2296`)."""
     from examl_tpu.optimize.branch import tree_evaluate
     from examl_tpu.optimize.model_opt import mod_opt
+    from examl_tpu.search.checkpoint import CheckpointManager
 
     if not args.tree_file:
         files.info("tree evaluation mode requires -t")
         return 1
     trees_txt = _read_trees(args.tree_file)
+    if not trees_txt:
+        files.info(f"no trees found in {args.tree_file}")
+        return 1
     files.info(f"Found {len(trees_txt)} trees to evaluate")
     fast = args.mode == "e"
+    mgr = CheckpointManager(args.workdir, args.run_id)
+
+    start_i = 0
     results = []
-    for i, txt in enumerate(trees_txt):
-        tree = inst.tree_from_newick(txt)
+    lnls = []
+    resumed_tree = None
+    if args.restart:
+        tree = inst.tree_from_newick(trees_txt[0])   # scaffold for restore
+        resume = mgr.restore(inst, tree)
+        if resume is None:
+            files.info("no checkpoint found; cannot restart")
+            return 1
+        if resume["state"] != "MOD_OPT":
+            files.info(f"checkpoint state {resume['state']} is not a "
+                       "tree-evaluation checkpoint")
+            return 1
+        ex = resume["extras"]
+        start_i = ex["tree_iteration"]
+        results = list(ex.get("results", []))
+        lnls = list(ex.get("lnls", []))
+        # Only a mid-optimization checkpoint carries a tree worth resuming
+        # into; a per-finished-tree checkpoint restarts at trees_txt[i+1].
+        resumed_tree = tree if ex.get("mid_tree") else None
+        files.info(f"restart at tree {start_i} with likelihood "
+                   f"{inst.likelihood:.6f}")
+
+    for i in range(start_i, len(trees_txt)):
+        if i == start_i and resumed_tree is not None:
+            tree = resumed_tree        # mid-optimization topology+branches
+        else:
+            tree = inst.tree_from_newick(trees_txt[i])
         inst.evaluate(tree, full=True)
+
+        def ckpt_cb(state: str, extras: dict, i=i, tree=tree) -> None:
+            merged = dict(extras)
+            merged.update(tree_iteration=i, results=results, lnls=lnls,
+                          mid_tree=True)
+            mgr.write(state, merged, inst, tree)
+
         if fast and i > 0:
             tree_evaluate(inst, tree, 2.0)
         else:
             tree_evaluate(inst, tree, 1.0)
-            mod_opt(inst, tree, 0.1)
+            mod_opt(inst, tree, 0.1, checkpoint_cb=ckpt_cb)
         files.info(f"Likelihood tree {i}: {inst.likelihood:.6f}")
         files.log_lnl(inst.likelihood)
         results.append(tree.to_newick(inst.alignment.taxon_names))
+        lnls.append(inst.likelihood)
+        # Per-finished-tree checkpoint so a restart moves on to tree i+1.
+        mgr.write("MOD_OPT", {"tree_iteration": i + 1, "results": results,
+                              "lnls": lnls}, inst, tree)
+    best = max(range(len(lnls)), key=lambda i: lnls[i])
+    files.info(f"Evaluated {len(lnls)} trees; best is tree {best} "
+               f"with likelihood {lnls[best]:.6f}")
     with open(files.treefile_path, "w") as f:
         f.write("\n".join(results) + "\n")
     write_model_params(files.model_path, inst)

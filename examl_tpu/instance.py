@@ -128,13 +128,19 @@ class PhyloInstance:
             self.push_models()
 
     def push_site_rates(self) -> None:
-        """Install the per-partition patrat vectors into the engines'
-        packed [B, lane] site-rate buffers (padding sites keep rate 1)."""
+        """Install the CATEGORIZED per-site rates into the engines' packed
+        [B, lane] site-rate buffers (padding sites keep rate 1).
+
+        Evaluation always runs under the <=25 category representatives
+        (`perSiteRates[rateCategory]`); `patrat` holds each site's
+        un-snapped scan optimum and only seeds the next scan (reference
+        distinction between patrat and perSiteRates, `axml.h:585-600`)."""
         assert self.psr
         for states, bucket in self.buckets.items():
             packed = np.ones(bucket.num_sites)
             for li, gid in enumerate(bucket.part_ids):
-                packed[bucket.site_indices(li)] = self.patrat[gid]
+                packed[bucket.site_indices(li)] = \
+                    self.per_site_rates[gid][self.rate_category[gid]]
             self.engines[states].set_site_rates(
                 packed.reshape(bucket.num_blocks, bucket.lane))
 

@@ -218,12 +218,17 @@ def opt_freqs(inst: PhyloInstance, tree: Tree,
 
 
 def mod_opt(inst: PhyloInstance, tree: Tree, likelihood_epsilon: float,
-            max_rounds: int = 100, auto_protein_fn=None) -> float:
+            max_rounds: int = 100, auto_protein_fn=None,
+            checkpoint_cb=None) -> float:
     """Round-robin parameter optimization until Delta lnL < epsilon
     (reference `modOpt`, `optimizeModel.c:2963-3133`).  Under GAMMA the
     rate-heterogeneity step is the alpha Brent; under PSR it is a rate
     categorization round, capped at 3 per search as the reference's
-    `catOpt < 3` (`optimizeModel.c:3100-3110`)."""
+    `catOpt < 3` (`optimizeModel.c:3100-3110`).
+
+    checkpoint_cb(state, extras), when given, is invoked after every
+    optimization round — the reference's MOD_OPT checkpoint cadence in
+    tree-evaluation mode (`optimizeModel.c:2995-3010`, `axml.h:655-659`)."""
     inst.evaluate(tree, full=True)
     if getattr(inst, "psr", False):
         inst.cat_opt_rounds = 0
@@ -253,6 +258,8 @@ def mod_opt(inst: PhyloInstance, tree: Tree, likelihood_epsilon: float,
             opt_alphas(inst, tree)
             opt_lg4x(inst, tree)
         tree_evaluate(inst, tree, 0.1)
+        if checkpoint_cb is not None:
+            checkpoint_cb("MOD_OPT", {})
         if abs(current - inst.likelihood) <= likelihood_epsilon:
             break
     return inst.likelihood

@@ -25,7 +25,10 @@ from examl_tpu.instance import PhyloInstance
 from examl_tpu.tree.topology import Tree
 
 MIN_RATE = 0.0001          # reference lower bound on trial rates
-RATE_STEPS = 16            # +-k steps of the reference's open-ended scan
+RATE_STEPS = 64            # +-k steps covering the reference's open-ended
+                           # scan reach (its crawl stops at the first
+                           # non-improving step; 64 steps of the same
+                           # spacing covers every realistic optimum)
 CAT_MERGE_TOL = 0.001      # rates closer than this share a category
 MAX_CAT_ROUNDS = 3         # catOpt < 3 in modOpt (optimizeModel.c:3100)
 
@@ -44,14 +47,19 @@ def _spacings(invocations: int) -> tuple[float, float]:
 def _scan_partition_rates(inst: PhyloInstance, tree: Tree,
                           lower: float, upper: float,
                           grid_chunk: int = 8) -> None:
-    """Update inst.patrat / inst.site_lhs with the best rate per site from
-    the candidate grid (the batched optRateCatPthreads)."""
+    """Update inst.patrat / inst.site_lhs with the best rate per site.
+
+    The batched replacement for the reference's per-site open-ended hill
+    climb (`optRateCatPthreads`): every site's lnL under a +-RATE_STEPS
+    candidate grid is computed by shared full traversals.  The grid is
+    deliberately the SAME arithmetic lattice (current rate + k*spacing)
+    the reference's crawl walks: sites landing on shared lattice values is
+    what lets `categorizeTheRates`-style mass-ranked category selection
+    find good representatives — re-centering per site was measured to
+    smear the lattice and cost ~300 lnL after categorization."""
     p, entries = tree.full_traversal()
-    offsets = np.concatenate([
-        -lower * np.arange(RATE_STEPS, 0, -1),
-        [0.0],
-        upper * np.arange(1, RATE_STEPS + 1)])
-    G = len(offsets)
+    up = upper * np.arange(1, RATE_STEPS + 1)
+    down = -lower * np.arange(1, RATE_STEPS + 1)
 
     for states, bucket in inst.buckets.items():
         eng = inst.engines[states]
@@ -59,31 +67,63 @@ def _scan_partition_rates(inst: PhyloInstance, tree: Tree,
         for li, gid in enumerate(bucket.part_ids):
             packed_r0[bucket.site_indices(li)] = inst.patrat[gid]
         r0 = packed_r0.reshape(bucket.num_blocks, bucket.lane)
+        # Pattern weights: per-site lnls are WEIGHT-MULTIPLIED exactly as
+        # the reference's `term * w` (`evaluatePartialGenericSpecial.c:
+        # 1049`).  This is load-bearing twice: high-weight (conserved)
+        # patterns crawl further before the epsilon stop, and the
+        # categorization ranks rate groups by weighted mass — without it
+        # the near-zero-rate category never wins a slot and PSR lands
+        # ~400 lnL short on testData/49.
+        w = bucket.weights.reshape(bucket.num_blocks, bucket.lane)
 
-        best_lnl = np.full((bucket.num_blocks, bucket.lane), -np.inf)
-        best_rate = r0.copy()
-        cur_lnl = None
-        for start in range(0, G, grid_chunk):
-            offs = offsets[start:start + grid_chunk]
+        def eval_offsets(offs):
             grid = r0[:, :, None] + offs[None, None, :]
             valid = grid > MIN_RATE
             grid = np.maximum(grid, MIN_RATE)
             lnls = eng.rate_scan(entries, p.number, p.back.number, p.z,
-                                 grid)                       # [B, lane, Gc]
-            lnls = np.where(valid, lnls, -np.inf)
-            if 0.0 in offs:
-                cur_lnl = lnls[:, :, list(offs).index(0.0)]
-            c = np.argmax(lnls, axis=2)
-            cl = np.take_along_axis(lnls, c[:, :, None], 2)[:, :, 0]
-            cr = np.take_along_axis(grid, c[:, :, None], 2)[:, :, 0]
-            upd = cl > best_lnl
-            best_lnl = np.where(upd, cl, best_lnl)
-            best_rate = np.where(upd, cr, best_rate)
-        # Keep the current rate unless a probe strictly improved on it
-        # (reference accepts left/right only if > initialLikelihood).
-        keep = best_lnl <= cur_lnl
-        best_rate = np.where(keep, r0, best_rate)
-        best_lnl = np.where(keep, cur_lnl, best_lnl)
+                                 grid) * w[:, :, None]       # [B, lane, Gc]
+            return np.where(valid, lnls, -np.inf)
+
+        cur_lnl = eval_offsets(np.zeros(1))[:, :, 0]
+
+        def crawl(dir_offsets):
+            """Directional crawl with the reference's stop rule: continue
+            only while the next step improves by more than epsilon=1e-5
+            (`optRateCatPthreads` while conditions) — the early stop keeps
+            sites clustered on few shared lattice rates, which the
+            mass-ranked categorization depends on.  Grid chunks are
+            evaluated lazily in walk order and the scan stops fetching
+            once every site's crawl has died, so the typical cost is a
+            couple of chunks, not the full RATE_STEPS reach."""
+            best = cur_lnl.copy()
+            best_r = r0.copy()
+            alive = np.ones_like(best, dtype=bool)
+            for start in range(0, len(dir_offsets), grid_chunk):
+                offs = dir_offsets[start:start + grid_chunk]
+                lnls = eval_offsets(offs)
+                for k in range(len(offs)):
+                    v = lnls[:, :, k]
+                    step = alive & (v > best) & (np.abs(best - v) > 1e-5)
+                    rate_k = np.maximum(r0 + offs[k], MIN_RATE)
+                    best = np.where(step, v, best)
+                    best_r = np.where(step, rate_k, best_r)
+                    alive = step
+                if not alive.any():
+                    break
+            return best, best_r
+
+        up_lnl, up_rate = crawl(up)
+        dn_lnl, dn_rate = crawl(down)
+        # Pick the better crawl end if it strictly beats the current rate
+        # (reference: right wins ties, then left, else keep initial).
+        best_lnl = cur_lnl.copy()
+        best_rate = r0.copy()
+        use_up = (up_lnl > cur_lnl) & (up_lnl >= dn_lnl)
+        use_dn = (dn_lnl > cur_lnl) & ~use_up
+        best_lnl = np.where(use_up, up_lnl, np.where(use_dn, dn_lnl,
+                                                     best_lnl))
+        best_rate = np.where(use_up, up_rate, np.where(use_dn, dn_rate,
+                                                       best_rate))
 
         flat_rate = best_rate.reshape(-1)
         flat_lnl = best_lnl.reshape(-1)
@@ -146,8 +186,15 @@ def _normalize_mean_rate(inst: PhyloInstance) -> None:
         scale = num / den
         for gid in range(len(parts)):
             inst.per_site_rates[gid] = inst.per_site_rates[gid] / scale
-    for gid in range(len(parts)):
-        inst.patrat[gid] = inst.per_site_rates[gid][inst.rate_category[gid]]
+    # NOTE: patrat deliberately keeps the UN-snapped per-site scan optima —
+    # the reference likewise scales only perSiteRates (the category
+    # representatives used for evaluation) and leaves patrat as each
+    # site's own running optimum, which seeds the next scan invocation
+    # (`updatePerSiteRates` touches only perSiteRates,
+    # `optimizeModel.c:2060-2120`; categorizePartition never writes
+    # patrat).  Snapping patrat to category rates each round collapses the
+    # per-site resolution and was measured to cost ~800 lnL on
+    # testData/49 PSR.
 
 
 def optimize_rate_categories(inst: PhyloInstance, tree: Tree,
@@ -173,7 +220,6 @@ def optimize_rate_categories(inst: PhyloInstance, tree: Tree,
             inst.patrat[gid], inst.site_lhs[gid], max_categories)
         inst.rate_category[gid] = cat
         inst.per_site_rates[gid] = kept
-        inst.patrat[gid] = kept[cat]
     _normalize_mean_rate(inst)
     inst.push_site_rates()
 

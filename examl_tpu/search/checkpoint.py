@@ -73,6 +73,10 @@ def _models_blob(inst: PhyloInstance) -> list:
             # host-resident per partition already).
             d["rate_category"] = inst.rate_category[gid].tolist()
             d["per_site_rates"] = inst.per_site_rates[gid].tolist()
+            # patrat = un-snapped per-site scan optima; distinct state
+            # from the categorized evaluation rates (reference
+            # patrat vs perSiteRates, `axml.h:585-600`).
+            d["patrat"] = inst.patrat[gid].tolist()
         out.append(d)
     return out
 
@@ -103,8 +107,9 @@ def _restore_models(inst: PhyloInstance, blob: list) -> None:
             inst.rate_category[gid] = np.asarray(d["rate_category"],
                                                  dtype=np.int32)
             inst.per_site_rates[gid] = np.asarray(d["per_site_rates"])
-            inst.patrat[gid] = inst.per_site_rates[gid][
-                inst.rate_category[gid]]
+            inst.patrat[gid] = np.asarray(
+                d.get("patrat", inst.per_site_rates[gid][
+                    inst.rate_category[gid]].tolist()))
     inst.push_models()
     if getattr(inst, "psr", False):
         inst.push_site_rates()

@@ -37,6 +37,18 @@ class RfConvergence:
         self._prev: Dict[str, Optional[Key]] = {}
         self.last_rrf: Optional[float] = None
 
+    def to_blob(self) -> dict:
+        """JSON-serializable previous-cycle bipartition sets, persisted in
+        checkpoints so a -D restart does not lose a cycle of convergence
+        evidence (the reference re-parses its stored newick strings for
+        this, `restartHashTable.c:279-357`)."""
+        return {phase: sorted(sorted(b) for b in key)
+                for phase, key in self._prev.items() if key is not None}
+
+    def load_blob(self, blob: dict) -> None:
+        self._prev = {phase: frozenset(frozenset(b) for b in bips)
+                      for phase, bips in blob.items()}
+
     def __call__(self, tree: Tree, phase: str, iteration: int) -> bool:
         key = topology_key(tree)
         prev = self._prev.get(phase)

@@ -13,7 +13,8 @@ from tests.conftest import TESTDATA
 
 # A byteFile produced by the reference parser (parser/axml.c) if one has
 # been generated locally; the roundtrip tests do not require it.
-REF_BYTEFILE = "/tmp/t49.binary"
+REF_BYTEFILE = os.path.join(os.path.dirname(__file__), "fixtures",
+                            "ref49", "aln49.binary")
 
 
 @pytest.fixture(scope="module")
@@ -46,8 +47,6 @@ def test_write_read_roundtrip_exact(tmp_path_factory, data49, tree49_text):
         i2.evaluate(t2, full=True), abs=1e-9)
 
 
-@pytest.mark.skipif(not os.path.exists(REF_BYTEFILE),
-                    reason="reference parser output not present")
 def test_read_reference_parser_output(data49, tree49_text):
     """Our reader consumes the reference parser's binary; patterns and
     weights agree exactly, lnL agrees to the empirical-frequency rounding

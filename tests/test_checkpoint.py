@@ -108,3 +108,74 @@ def test_restart_reaches_continuous_result(tmp_path):
     res2 = compute_big_rapid(inst2, tree2, SearchOptions(
         initial_set=True, initial=5), resume=resume)
     assert res2.likelihood >= res.likelihood - 0.5
+
+
+def test_rf_history_roundtrip():
+    """RF-convergence evidence survives checkpoint serialization: a -D
+    restart keeps comparing against the pre-restart cycle (reference
+    `restartHashTable.c:279-357`)."""
+    inst = PhyloInstance(_correlated_dna(10, 60, seed=3))
+    t = inst.random_tree(seed=1)
+    conv = RfConvergence(10)
+    conv(t, "fast", 0)
+    blob = conv.to_blob()
+    import json
+    blob = json.loads(json.dumps(blob))    # through the JSON checkpoint
+    conv2 = RfConvergence(10)
+    conv2.load_blob(blob)
+    # identical tree right after restart -> rrf == 0 -> converged signal
+    assert conv2(t, "fast", 1)
+    t2 = inst.random_tree(seed=2)
+    conv3 = RfConvergence(10)
+    conv3.load_blob(blob)
+    assert not conv3(t2, "fast", 1)
+
+
+@pytest.mark.slow
+def test_tree_evaluation_mode_restart(tmp_path):
+    """-f e writes MOD_OPT checkpoints; a restarted run resumes after the
+    last finished tree and reproduces the uninterrupted run's results
+    (reference `axml.h:655-659`, dispatch `searchAlgo.c:1730-1749`)."""
+    import re
+
+    from examl_tpu.cli.main import main as cli_main
+    from examl_tpu.io.bytefile import write_bytefile
+
+    data = _correlated_dna(12, 200, seed=5)
+    inst = PhyloInstance(data)
+    aln = str(tmp_path / "aln.binary")
+    write_bytefile(aln, data)
+    trees = str(tmp_path / "trees.nwk")
+    with open(trees, "w") as f:
+        for seed in (1, 2, 3):
+            t = inst.random_tree(seed=seed)
+            f.write(t.to_newick(data.taxon_names) + "\n")
+
+    w1 = str(tmp_path / "w1")
+    assert cli_main(["-s", aln, "-t", trees, "-n", "FULL", "-f", "e",
+                     "-w", w1]) == 0
+    full_info = open(f"{w1}/ExaML_info.FULL").read()
+    full_lnls = re.findall(r"Likelihood tree \d+: (-[\d.]+)", full_info)
+    assert len(full_lnls) == 3
+
+    # Interrupted run: evaluate only tree 0 by truncating the input, then
+    # restart with the full file from the checkpoint.
+    w2 = str(tmp_path / "w2")
+    trees1 = str(tmp_path / "first.nwk")
+    with open(trees1, "w") as f:
+        f.write(open(trees).readline())
+    assert cli_main(["-s", aln, "-t", trees1, "-n", "RES", "-f", "e",
+                     "-w", w2]) == 0
+    assert cli_main(["-s", aln, "-t", trees, "-n", "RES", "-f", "e",
+                     "-R", "-w", w2]) == 0
+    res_info = open(f"{w2}/ExaML_info.RES").read()
+    res_lnls = re.findall(r"Likelihood tree (\d+): (-[\d.]+)", res_info)
+    # restart continued at tree 1 and 2 (tree 0 not recomputed)
+    assert [i for i, _ in res_lnls].count("0") == 1
+    got = {i: float(v) for i, v in res_lnls}
+    want = {str(i): float(v) for i, v in enumerate(full_lnls)}
+    for i in ("0", "1", "2"):
+        assert got[i] == pytest.approx(want[i], abs=0.05), (i, got, want)
+    # results file contains all three trees
+    out_trees = open(f"{w2}/ExaML_TreeFile.RES").read().strip().split("\n")
+    assert len(out_trees) == 3

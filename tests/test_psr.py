@@ -40,6 +40,11 @@ def test_psr_lnl_matches_oracle(psr_inst):
     rates = np.array([0.1, 0.5, 1.0, 2.0, 4.0])[cats]
     w = inst.alignment.partitions[0].weights
     rates = rates / (float(w @ rates) / float(w.sum()))
+    # Install as categorized rates: evaluation runs under
+    # perSiteRates[rateCategory] (patrat only seeds the scans).
+    kept = np.unique(rates)
+    inst.per_site_rates[0] = kept
+    inst.rate_category[0] = np.searchsorted(kept, rates).astype(np.int32)
     inst.patrat[0] = rates
     inst.push_site_rates()
 
@@ -48,6 +53,8 @@ def test_psr_lnl_matches_oracle(psr_inst):
                      site_rates=[rates])
     assert lnl == pytest.approx(ref, rel=1e-9)
     # And uniform rates reproduce the single-rate model.
+    inst.per_site_rates[0] = np.ones(1)
+    inst.rate_category[0] = np.zeros(W, dtype=np.int32)
     inst.patrat[0] = np.ones(W)
     inst.push_site_rates()
     lnl1 = inst.evaluate(tree, full=True)
@@ -112,9 +119,12 @@ def test_psr_optimization_round_improves_and_normalizes():
     lnl1 = optimize_rate_categories(inst, tree, max_categories=25)
     assert lnl1 >= lnl0 - 1e-9
     assert len(inst.per_site_rates[0]) <= 25
-    # Weighted mean rate == 1 after normalization.
+    # Weighted mean of the CATEGORIZED rates == 1 after normalization
+    # (patrat keeps the un-normalized per-site scan optima, mirroring the
+    # reference's patrat vs perSiteRates distinction).
     part = inst.alignment.partitions[0]
-    mean = float(part.weights @ inst.patrat[0]) / float(part.weights.sum())
+    cat_rates = inst.per_site_rates[0][inst.rate_category[0]]
+    mean = float(part.weights @ cat_rates) / float(part.weights.sum())
     assert mean == pytest.approx(1.0, abs=1e-9)
     # A second round with tighter spacing keeps improving or holds.
     lnl2 = optimize_rate_categories(inst, tree, max_categories=25)
