@@ -19,6 +19,7 @@ ExaML_TreeFile.RUNID (-f e/E per-tree results).
 from __future__ import annotations
 
 import argparse
+import contextlib
 import os
 import sys
 import time
@@ -64,6 +65,9 @@ def build_argparser() -> argparse.ArgumentParser:
                     help="memory saving for gappy alignments")
     ap.add_argument("-w", dest="workdir", default=".",
                     help="output directory")
+    ap.add_argument("--profile", dest="profile_dir", default=None,
+                    help="write a jax profiler trace to this directory "
+                         "(SURVEY §5.1; view with xprof/tensorboard)")
     ap.add_argument("-g", dest="constraint_file", default=None,
                     help="multifurcating constraint tree")
     ap.add_argument("-p", dest="seed", type=int, default=12345,
@@ -97,6 +101,7 @@ class RunFiles:
         self.treefile_path = f"{pre}TreeFile.{run_id}"
         self.quartets_path = f"{pre}quartets.{run_id}"
         self.start_time = time.time()
+        self._phases = {}
         if not append:
             for p in (self.info_path, self.log_path):
                 open(p, "w").close()
@@ -105,6 +110,30 @@ class RunFiles:
         print(msg)
         with open(self.info_path, "a") as f:
             f.write(msg + "\n")
+
+    # -- per-phase wall-time accounting (SURVEY §5.1: the reference has
+    # only gettime()/accumulatedTime; phase times in ExaML_info are the
+    # first-class observability the survey flags as missing) -------------
+
+    @contextlib.contextmanager
+    def phase(self, name: str):
+        t0 = time.time()
+        try:
+            yield
+        finally:
+            self._phases[name] = self._phases.get(name, 0.0) \
+                + time.time() - t0
+
+    def report_phases(self) -> None:
+        phases = self._phases
+        if not phases:
+            return
+        total = time.time() - self.start_time
+        self.info("")
+        self.info("Wall-clock by phase:")
+        for name, dt in phases.items():
+            self.info(f"  {name:24s} {dt:10.2f} s  ({100*dt/total:5.1f}%)")
+        self.info(f"  {'total':24s} {total:10.2f} s")
 
     def log_lnl(self, lnl: float) -> None:
         with open(self.log_path, "a") as f:
@@ -236,6 +265,7 @@ def run_search(args, inst, files: RunFiles) -> int:
 
     files.info(f"Likelihood of best tree: {res.likelihood:.6f}")
     files.write_result(tree.to_newick(inst.alignment.taxon_names))
+    _write_per_gene_trees(args, inst, tree, files)
     write_model_params(files.model_path, inst)
     if res.good_trees:
         good = os.path.join(args.workdir,
@@ -247,6 +277,21 @@ def run_search(args, inst, files: RunFiles) -> int:
         files.info(f"{len(res.good_trees)} other good trees written to "
                    f"{good}")
     return 0
+
+
+def _write_per_gene_trees(args, inst, tree, files: RunFiles) -> None:
+    """Under -M, write one tree per partition with that partition's own
+    branch lengths (reference `printTreePerGene`, `treeIO.c:348`)."""
+    if not args.per_partition_bl:
+        return
+    path = os.path.join(args.workdir,
+                        f"ExaML_perGeneBranchLengths.{args.run_id}")
+    with open(path, "w") as f:
+        for gid, part in enumerate(inst.alignment.partitions):
+            f.write(f"[partition {gid} {part.name}]\n")
+            f.write(tree.to_newick(inst.alignment.taxon_names,
+                                   branch_index=gid) + "\n")
+    files.info(f"Per-partition branch-length trees written to {path}")
 
 
 def run_tree_evaluation(args, inst, files: RunFiles) -> int:
@@ -268,7 +313,11 @@ def run_tree_evaluation(args, inst, files: RunFiles) -> int:
         return 1
     files.info(f"Found {len(trees_txt)} trees to evaluate")
     fast = args.mode == "e"
-    mgr = CheckpointManager(args.workdir, args.run_id)
+    # -f e over thousands of trees: keep only the last 2 numbered
+    # checkpoints (each embeds the accumulated results) and rate-limit
+    # the mid-optimization cadence, else checkpoint bytes grow O(N^2).
+    mgr = CheckpointManager(args.workdir, args.run_id, keep_last=2)
+    last_ckpt = [0.0]
 
     start_i = 0
     results = []
@@ -302,10 +351,13 @@ def run_tree_evaluation(args, inst, files: RunFiles) -> int:
         inst.evaluate(tree, full=True)
 
         def ckpt_cb(state: str, extras: dict, i=i, tree=tree) -> None:
+            if time.time() - last_ckpt[0] < 60.0:
+                return                      # mid-tree cadence: >= 60 s apart
             merged = dict(extras)
             merged.update(tree_iteration=i, results=results, lnls=lnls,
                           mid_tree=True)
             mgr.write(state, merged, inst, tree)
+            last_ckpt[0] = time.time()
 
         if fast and i > 0:
             tree_evaluate(inst, tree, 2.0)
@@ -319,6 +371,7 @@ def run_tree_evaluation(args, inst, files: RunFiles) -> int:
         # Per-finished-tree checkpoint so a restart moves on to tree i+1.
         mgr.write("MOD_OPT", {"tree_iteration": i + 1, "results": results,
                               "lnls": lnls}, inst, tree)
+        last_ckpt[0] = time.time()
     best = max(range(len(lnls)), key=lambda i: lnls[i])
     files.info(f"Evaluated {len(lnls)} trees; best is tree {best} "
                f"with likelihood {lnls[best]:.6f}")
@@ -326,6 +379,22 @@ def run_tree_evaluation(args, inst, files: RunFiles) -> int:
         f.write("\n".join(results) + "\n")
     write_model_params(files.model_path, inst)
     return 0
+
+
+def _packing_report(inst, files: RunFiles) -> None:
+    """Startup site-packing / load report (the reference's
+    `printAssignments`/`printLoad`, `partitionAssignment.c:461-502` —
+    here the 'load balance' is lane padding per state bucket)."""
+    for states, bucket in sorted(inst.buckets.items()):
+        true_sites = int(sum(bucket.part_widths))
+        padded = bucket.num_sites
+        files.info(
+            f"bucket states={states}: {bucket.num_parts} partitions, "
+            f"{true_sites} patterns -> {bucket.num_blocks} blocks x "
+            f"{bucket.lane} lanes ({padded - true_sites} padding sites, "
+            f"{100.0 * (padded - true_sites) / padded:.1f}% pad)")
+        if getattr(inst, "save_memory", False):
+            files.info(f"  SEV (-S) pool active for this bucket")
 
 
 def main(argv=None) -> int:
@@ -337,25 +406,50 @@ def main(argv=None) -> int:
                f"model: {args.model}")
 
     from examl_tpu.instance import PhyloInstance
-    data = _load_alignment(args.bytefile)
-    files.info(f"{data.ntaxa} taxa, {data.total_patterns} patterns, "
-               f"{len(data.partitions)} partitions")
 
-    inst = PhyloInstance(
-        data, ncat=4, use_median=args.median,
-        per_partition_branches=args.per_partition_bl,
-        rate_model=args.model, psr_categories=args.categories,
-        save_memory=args.save_memory)
-    inst.auto_prot_criterion = args.auto_prot
+    with files.phase("startup (io + engines)"):
+        data = _load_alignment(args.bytefile)
+        files.info(f"{data.ntaxa} taxa, {data.total_patterns} patterns, "
+                   f"{len(data.partitions)} partitions")
 
-    if args.mode in ("d", "o"):
-        return run_search(args, inst, files)
-    if args.mode in ("e", "E"):
-        return run_tree_evaluation(args, inst, files)
-    if args.mode == "q":
-        from examl_tpu.cli.quartets import run_quartets
-        return run_quartets(args, inst, files)
-    raise AssertionError(args.mode)
+        inst = PhyloInstance(
+            data, ncat=4, use_median=args.median,
+            per_partition_branches=args.per_partition_bl,
+            rate_model=args.model, psr_categories=args.categories,
+            save_memory=args.save_memory)
+        inst.auto_prot_criterion = args.auto_prot
+        _packing_report(inst, files)
+
+    profile_ctx = None
+    if args.profile_dir:
+        import jax
+
+        profile_ctx = jax.profiler.trace(args.profile_dir)
+        files.info(f"profiler trace -> {args.profile_dir}")
+        profile_ctx.__enter__()
+    try:
+        with files.phase(f"inference (-f {args.mode})"):
+            if args.mode in ("d", "o"):
+                rc = run_search(args, inst, files)
+            elif args.mode in ("e", "E"):
+                rc = run_tree_evaluation(args, inst, files)
+            elif args.mode == "q":
+                from examl_tpu.cli.quartets import run_quartets
+                rc = run_quartets(args, inst, files)
+            else:
+                raise AssertionError(args.mode)
+    finally:
+        if profile_ctx is not None:
+            profile_ctx.__exit__(None, None, None)
+    if getattr(inst, "save_memory", False):
+        for states, eng in inst.engines.items():
+            st = eng.sev.stats()
+            files.info(
+                f"SEV bucket states={states}: {st['allocated_cells']} of "
+                f"{st['dense_cells']} CLV cells allocated "
+                f"({100.0 * st['saving_ratio']:.1f}% saved)")
+    files.report_phases()
+    return rc
 
 
 if __name__ == "__main__":

@@ -114,11 +114,13 @@ def _scan_partition_rates(inst: PhyloInstance, tree: Tree,
 
         up_lnl, up_rate = crawl(up)
         dn_lnl, dn_rate = crawl(down)
-        # Pick the better crawl end if it strictly beats the current rate
-        # (reference: right wins ties, then left, else keep initial).
+        # Pick the better crawl end if it strictly beats the current
+        # rate; on an exact up-vs-down tie the DOWN rate wins, as the
+        # reference's `if(rightLH > leftLH) right else left`
+        # (`optimizeModel.c:1905-1917`).
         best_lnl = cur_lnl.copy()
         best_rate = r0.copy()
-        use_up = (up_lnl > cur_lnl) & (up_lnl >= dn_lnl)
+        use_up = (up_lnl > cur_lnl) & (up_lnl > dn_lnl)
         use_dn = (dn_lnl > cur_lnl) & ~use_up
         best_lnl = np.where(use_up, up_lnl, np.where(use_dn, dn_lnl,
                                                      best_lnl))

@@ -125,9 +125,16 @@ class CheckpointManager:
 
     FILE_RE = re.compile(r"\.ckpt_(\d+)\.json\.gz$")
 
-    def __init__(self, workdir: str, run_id: str):
+    def __init__(self, workdir: str, run_id: str,
+                 keep_last: Optional[int] = None):
         self.workdir = workdir
         self.run_id = run_id
+        # keep_last: prune checkpoints older than the newest N after each
+        # write (None = keep all, the search default mirroring the
+        # reference's never-overwritten numbered files).  Modes that write
+        # per work item (e.g. -f e over thousands of trees) pass a small
+        # N so disk use stays linear.
+        self.keep_last = keep_last
         os.makedirs(workdir, exist_ok=True)
         self.counter = self._max_existing() + 1
 
@@ -174,6 +181,11 @@ class CheckpointManager:
             json.dump(blob, f)
         os.replace(tmp, path)       # atomic publish; never overwrite older
         self.counter += 1
+        if self.keep_last is not None:
+            for n in range(self.counter - self.keep_last):
+                old = self.path_for(n)
+                if os.path.exists(old):
+                    os.remove(old)
         return path
 
     def callback(self, inst: PhyloInstance, tree: Tree):

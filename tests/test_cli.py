@@ -72,3 +72,47 @@ def test_driver_search_end_to_end(tmp_path):
     first = float(log_rows[0].split()[1])
     assert final > first
     assert "alpha" in (tmp_path / "ExaML_modelFile.E2E").read_text()
+
+
+@pytest.mark.slow
+def test_driver_search_per_partition_branches(tmp_path):
+    """-M run writes the per-gene branch-length trees file with distinct
+    branch lengths per partition (reference `printTreePerGene`,
+    `treeIO.c:348`) and reports phase times in ExaML_info."""
+    from examl_tpu.cli.main import main as run_main
+    from examl_tpu.instance import PhyloInstance
+    from examl_tpu.io.alignment import build_alignment_data
+    from examl_tpu.io.bytefile import write_bytefile
+    from examl_tpu.io.partitions import parse_partition_file
+
+    rng = np.random.default_rng(1)
+    # two genes with different divergence so -M estimates different
+    # branch lengths per partition
+    seqs = []
+    cur1 = rng.integers(0, 4, 120)
+    cur2 = rng.integers(0, 4, 120)
+    for _ in range(8):
+        cur1 = np.where(rng.random(120) < 0.05, rng.integers(0, 4, 120), cur1)
+        cur2 = np.where(rng.random(120) < 0.35, rng.integers(0, 4, 120), cur2)
+        seqs.append("".join("ACGT"[c] for c in np.concatenate([cur1, cur2])))
+    mp = tmp_path / "parts.model"
+    mp.write_text("DNA, g1 = 1-120\nDNA, g2 = 121-240\n")
+    data = build_alignment_data([f"t{i}" for i in range(8)], seqs,
+                                specs=parse_partition_file(str(mp)))
+    write_bytefile(str(tmp_path / "a.binary"), data)
+    inst = PhyloInstance(data)
+    (tmp_path / "start.nwk").write_text(
+        inst.random_tree(seed=3).to_newick(data.taxon_names))
+
+    rc = run_main(["-s", str(tmp_path / "a.binary"), "-n", "PM",
+                   "-t", str(tmp_path / "start.nwk"), "-f", "d", "-M",
+                   "-i", "5", "-w", str(tmp_path)])
+    assert rc == 0
+    per_gene = (tmp_path / "ExaML_perGeneBranchLengths.PM").read_text()
+    blocks = [b for b in per_gene.split("[partition") if ";" in b]
+    assert len(blocks) == 2
+    t1 = blocks[0].split("]\n")[1].strip()
+    t2 = blocks[1].split("]\n")[1].strip()
+    assert t1 != t2, "per-partition branch lengths did not differ"
+    info = (tmp_path / "ExaML_info.PM").read_text()
+    assert "Wall-clock by phase" in info
