@@ -420,14 +420,12 @@ def main(argv=None) -> int:
         inst.auto_prot_criterion = args.auto_prot
         _packing_report(inst, files)
 
-    profile_ctx = None
-    if args.profile_dir:
-        import jax
+    with contextlib.ExitStack() as stack:
+        if args.profile_dir:
+            import jax
 
-        profile_ctx = jax.profiler.trace(args.profile_dir)
-        files.info(f"profiler trace -> {args.profile_dir}")
-        profile_ctx.__enter__()
-    try:
+            stack.enter_context(jax.profiler.trace(args.profile_dir))
+            files.info(f"profiler trace -> {args.profile_dir}")
         with files.phase(f"inference (-f {args.mode})"):
             if args.mode in ("d", "o"):
                 rc = run_search(args, inst, files)
@@ -438,9 +436,6 @@ def main(argv=None) -> int:
                 rc = run_quartets(args, inst, files)
             else:
                 raise AssertionError(args.mode)
-    finally:
-        if profile_ctx is not None:
-            profile_ctx.__exit__(None, None, None)
     if getattr(inst, "save_memory", False):
         for states, eng in inst.engines.items():
             st = eng.sev.stats()

@@ -122,7 +122,44 @@ class _Parser:
         return float("".join(out))
 
 
+def _parse_newick_native(text: str) -> Optional[NewickNode]:
+    """Build the NewickNode tree from the C++ scanner's flat arrays
+    (native/newickscan.cpp); None when the extension is unavailable."""
+    try:
+        from examl_tpu import _newickscan
+    except ImportError:
+        return None
+    import math
+
+    import numpy as np
+
+    pb, lb, fb, labels = _newickscan.scan(text)
+    parent = np.frombuffer(pb, dtype=np.int32)
+    length = np.frombuffer(lb, dtype=np.float64)
+    is_leaf = np.frombuffer(fb, dtype=np.uint8)
+    nodes = [NewickNode() for _ in range(len(parent))]
+    for i, node in enumerate(nodes):
+        if labels[i]:
+            node.name = labels[i]
+        if not math.isnan(length[i]):
+            node.length = float(length[i])
+        if not is_leaf[i]:
+            node.children = []
+    root = None
+    # children get smaller ids than their parent, so ascending order
+    # appends children in their original left-to-right order
+    for i, p in enumerate(parent):
+        if p < 0:
+            root = nodes[i]
+        else:
+            nodes[p].children.append(nodes[i])
+    return root
+
+
 def parse_newick(text: str) -> NewickNode:
+    root = _parse_newick_native(text)
+    if root is not None:
+        return root
     return _Parser(text).parse()
 
 

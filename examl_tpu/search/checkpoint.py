@@ -182,10 +182,15 @@ class CheckpointManager:
         os.replace(tmp, path)       # atomic publish; never overwrite older
         self.counter += 1
         if self.keep_last is not None:
-            for n in range(self.counter - self.keep_last):
-                old = self.path_for(n)
-                if os.path.exists(old):
-                    os.remove(old)
+            # Only one index can newly expire per write; older ones were
+            # removed by earlier writes (restart picks up mid-sequence,
+            # so tolerate an already-missing file).
+            expired = self.counter - self.keep_last - 1
+            if expired >= 0:
+                try:
+                    os.remove(self.path_for(expired))
+                except FileNotFoundError:
+                    pass
         return path
 
     def callback(self, inst: PhyloInstance, tree: Tree):

