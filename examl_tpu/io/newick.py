@@ -133,18 +133,15 @@ def _parse_newick_native(text: str) -> Optional[NewickNode]:
 
     import numpy as np
 
-    pb, lb, fb, labels = _newickscan.scan(text)
+    pb, lb, _fb, labels = _newickscan.scan(text)
     parent = np.frombuffer(pb, dtype=np.int32)
     length = np.frombuffer(lb, dtype=np.float64)
-    is_leaf = np.frombuffer(fb, dtype=np.uint8)
     nodes = [NewickNode() for _ in range(len(parent))]
     for i, node in enumerate(nodes):
         if labels[i]:
             node.name = labels[i]
         if not math.isnan(length[i]):
             node.length = float(length[i])
-        if not is_leaf[i]:
-            node.children = []
     root = None
     # children get smaller ids than their parent, so ascending order
     # appends children in their original left-to-right order

@@ -181,17 +181,24 @@ class CheckpointManager:
             json.dump(blob, f)
         os.replace(tmp, path)       # atomic publish; never overwrite older
         self.counter += 1
-        if self.keep_last is not None:
-            # Only one index can newly expire per write; older ones were
-            # removed by earlier writes (restart picks up mid-sequence,
-            # so tolerate an already-missing file).
-            expired = self.counter - self.keep_last - 1
-            if expired >= 0:
+        self._prune()
+        return path
+
+    def _prune(self) -> None:
+        """Sweep EVERY on-disk index older than the newest keep_last: a
+        crash between publish and prune, or a keep_last that shrank
+        across a restart, leaves older orphans that a newest-expired-only
+        removal would leak forever."""
+        if self.keep_last is None:
+            return
+        cutoff = self.counter - self.keep_last
+        for f in glob.glob(self._pattern()):
+            m = self.FILE_RE.search(f)
+            if m and int(m.group(1)) < cutoff:
                 try:
-                    os.remove(self.path_for(expired))
+                    os.remove(f)
                 except FileNotFoundError:
                     pass
-        return path
 
     def callback(self, inst: PhyloInstance, tree: Tree):
         def cb(state: str, extras: dict) -> None:

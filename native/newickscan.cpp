@@ -19,6 +19,7 @@
 #define PY_SSIZE_T_CLEAN
 #include <Python.h>
 
+#include <charconv>
 #include <cmath>
 #include <cstdint>
 #include <cstdlib>
@@ -119,14 +120,18 @@ bool scan_newick(const char *s, size_t n, Scan &out) {
     if (i < n && s[i] == ':') {
       i++;
       skip_ws(s, n, i);
-      char *endp = nullptr;
-      double len = strtod(s + i, &endp);
-      if (endp == s + i) {
+      /* std::from_chars: locale-independent (strtod honors LC_NUMERIC,
+       * so a comma-decimal locale would reject valid trees).  It takes
+       * no leading '+', which float() accepts -- skip one ourselves. */
+      size_t j = i + (i < n && s[i] == '+' ? 1 : 0);
+      double len = 0.0;
+      auto res = std::from_chars(s + j, s + n, len);
+      if (res.ec != std::errc() || res.ptr == s + j) {
         out.error = "bad branch length at " + std::to_string(i);
         return false;
       }
       out.length[node] = len;
-      i = (size_t)(endp - s);
+      i = (size_t)(res.ptr - s);
     }
 
     if (open.empty()) {

@@ -179,3 +179,20 @@ def test_tree_evaluation_mode_restart(tmp_path):
     # results file contains all three trees
     out_trees = open(f"{w2}/ExaML_TreeFile.RES").read().strip().split("\n")
     assert len(out_trees) == 3
+
+
+def test_prune_sweeps_orphans(tmp_path):
+    """keep_last pruning removes EVERY stale index, not just the newest
+    expired one: orphans from a crash between publish and prune, or from
+    a keep_last that shrank across a restart, must not leak."""
+    from examl_tpu.search.checkpoint import CheckpointManager
+
+    mgr = CheckpointManager(str(tmp_path), "PR", keep_last=2)
+    for i in (0, 1, 3, 4, 7):        # gaps simulate prior crashes
+        with open(mgr.path_for(i), "w") as f:
+            f.write("x")
+    mgr.counter = 8
+    mgr._prune()
+    import glob as _glob
+    left = sorted(_glob.glob(mgr._pattern()))
+    assert left == [mgr.path_for(7)], left
