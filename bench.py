@@ -101,8 +101,6 @@ def main() -> None:
 
     import jax.numpy as jnp
 
-    from examl_tpu.ops import fastpath
-
     eng = inst.engines[20]
     _, entries = tree.full_traversal_centroid()
     sched = eng._fast_schedule(entries)
@@ -111,12 +109,11 @@ def main() -> None:
 
     # n_steps dependency-chained traversals inside ONE jit returning a
     # scalar: immune to async-dispatch/transfer artifacts of the TPU tunnel.
+    # run_chunks_traced selects Pallas kernels on TPU, plain XLA elsewhere.
     @jax.jit
     def chained(clv, scaler):
         def body(_, cs):
-            return fastpath.run_chunks(eng.models, eng.block_part, eng.tips,
-                                       cs[0], cs[1], chunks, eng.scale_exp,
-                                       eng.fast_precision)
+            return eng.run_chunks_traced(cs[0], cs[1], chunks)
         clv, scaler = jax.lax.fori_loop(0, n_steps, body, (clv, scaler))
         return jnp.sum(scaler)
 

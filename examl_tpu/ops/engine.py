@@ -124,9 +124,27 @@ class LikelihoodEngine:
         self.row_map = np.full(2 * ntips - 1, -1, dtype=np.int64)
         for num in range(ntips + 1, 2 * ntips - 1):
             self.row_map[num] = num - ntips - 1
-        self.fast_precision = jax.lax.Precision.HIGHEST
+        # Precision for the fast path's CHILD CLV contractions only.  These
+        # sums are all-positive (transition probabilities x likelihoods, no
+        # cancellation), so 3-pass bf16 (HIGH) costs 0.016 lnL absolute on
+        # testData/140 (1.2e-7 relative, NUMERICS.md) while halving MXU
+        # passes vs HIGHEST; P-matrix eigen-recomposition and the root
+        # evaluation stay at HIGHEST (cancellation-prone -- the measurement
+        # that rejected HIGH globally was dominated by those).  CPU ignores
+        # the knob (always true f32/f64).  EXAML_DOT_PRECISION overrides.
+        import os as _pos
+        _prec = _pos.environ.get("EXAML_DOT_PRECISION", "high").upper()
+        if _prec not in ("DEFAULT", "HIGH", "HIGHEST"):
+            raise ValueError(
+                f"EXAML_DOT_PRECISION={_prec!r}: expected one of "
+                "default/high/highest")
+        self.fast_precision = getattr(jax.lax.Precision, _prec)
         self._fast_jit_cache = {}
         self.sharding = sharding
+        self.pallas_interpret = _pos.environ.get(
+            "EXAML_PALLAS_INTERPRET", "") == "1"
+        self._want_pallas = _pos.environ.get("EXAML_PALLAS", "1") != "0"
+        self.use_pallas = False        # decided once tensors are placed
 
         lane = bucket.lane
         B = bucket.num_blocks
@@ -162,6 +180,21 @@ class LikelihoodEngine:
         self.scaler = jnp.zeros((self.num_rows, B, lane), dtype=jnp.int32)
         if sharding is not None:
             self.apply_sharding(sharding)
+        # Fused Pallas chunk kernels, gated on where the CLV arena actually
+        # LIVES (a jax.default_device(cpu) fallback leaves
+        # jax.default_backend() == "tpu", and lowering Mosaic kernels onto
+        # CPU devices crashes -- the platform must come from the placed
+        # tensor, not the default backend).  The plain-XLA fast path
+        # remains for CPU/f64 parity runs.  EXAML_PALLAS=0 disables;
+        # EXAML_PALLAS_INTERPRET=1 forces interpreted kernels anywhere
+        # (tests).
+        if self.clv is not None:
+            platform = next(iter(self.clv.devices())).platform
+            self.use_pallas = (
+                self._want_pallas and self.dtype == jnp.float32
+                and sharding is None
+                and (self.pallas_interpret
+                     or platform in ("tpu", "axon")))
 
         # One jitted traversal program; jax recompiles per padded entry-count
         # shape (powers of two, so only a handful of variants exist).  The
@@ -356,6 +389,27 @@ class LikelihoodEngine:
         for num, row in sched.row_of.items():
             self.row_map[num] = row
 
+    def _run_chunks_impl(self, dm, block_part, tips, clv, scaler, chunks):
+        """Chunk execution on the engine-selected backend path (Pallas on
+        TPU, plain XLA elsewhere); the ONE dispatch point shared by the
+        jitted fast programs and external harnesses."""
+        if self.use_pallas:
+            from examl_tpu.ops import pallas_newview
+            return pallas_newview.run_chunks(
+                dm, block_part, tips, clv, scaler, chunks,
+                self.scale_exp, precision=self.fast_precision,
+                interpret=self.pallas_interpret)
+        from examl_tpu.ops import fastpath
+        return fastpath.run_chunks(dm, block_part, tips, clv, scaler,
+                                   chunks, self.scale_exp,
+                                   self.fast_precision)
+
+    def run_chunks_traced(self, clv, scaler, chunks):
+        """Traceable chunk execution for harnesses that build their own
+        jit around the fast path (bench.py, perf lab)."""
+        return self._run_chunks_impl(self.models, self.block_part,
+                                     self.tips, clv, scaler, chunks)
+
     def _fast_fn(self, profile, with_eval: bool):
         key = (profile, with_eval)
         fn = self._fast_jit_cache.get(key)
@@ -367,9 +421,8 @@ class LikelihoodEngine:
                       block_part, weights, tips):
             chunks = [fastpath.FastChunk(kind, width, *cd)
                       for (kind, width), cd in zip(profile, chunk_data)]
-            clv, scaler = fastpath.run_chunks(
-                dm, block_part, tips, clv, scaler, chunks,
-                self.scale_exp, self.fast_precision)
+            clv, scaler = self._run_chunks_impl(dm, block_part, tips, clv,
+                                                scaler, chunks)
             lnl = kernels.root_log_likelihood(
                 dm, block_part, weights, tips, clv, scaler, p_idx, q_idx,
                 z, self.num_parts, self.scale_exp, self.ntips, None)
@@ -378,9 +431,8 @@ class LikelihoodEngine:
         def impl(clv, scaler, chunk_data, dm, block_part, tips):
             chunks = [fastpath.FastChunk(kind, width, *cd)
                       for (kind, width), cd in zip(profile, chunk_data)]
-            return fastpath.run_chunks(dm, block_part, tips, clv, scaler,
-                                       chunks, self.scale_exp,
-                                       self.fast_precision)
+            return self._run_chunks_impl(dm, block_part, tips, clv, scaler,
+                                         chunks)
 
         fn = jax.jit(impl_eval if with_eval else impl, donate_argnums=(0, 1))
         self._fast_jit_cache[key] = fn

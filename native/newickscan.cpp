@@ -122,16 +122,29 @@ bool scan_newick(const char *s, size_t n, Scan &out) {
       skip_ws(s, n, i);
       /* std::from_chars: locale-independent (strtod honors LC_NUMERIC,
        * so a comma-decimal locale would reject valid trees).  It takes
-       * no leading '+', which float() accepts -- skip one ourselves. */
+       * no leading '+', which float() accepts -- skip one ourselves.
+       * Floating-point from_chars needs libstdc++ >= GCC 11 (libc++ >=
+       * LLVM 20); older C++17 toolchains fall back to strtod and keep
+       * the (pre-existing) locale caveat rather than failing the
+       * build. */
       size_t j = i + (i < n && s[i] == '+' ? 1 : 0);
       double len = 0.0;
+#if defined(__cpp_lib_to_chars) && __cpp_lib_to_chars >= 201611L
       auto res = std::from_chars(s + j, s + n, len);
-      if (res.ec != std::errc() || res.ptr == s + j) {
+      bool bad = (res.ec != std::errc() || res.ptr == s + j);
+      const char *endp = res.ptr;
+#else
+      char *endp_m = nullptr;
+      len = strtod(s + j, &endp_m);
+      bool bad = (endp_m == s + j);
+      const char *endp = endp_m;
+#endif
+      if (bad) {
         out.error = "bad branch length at " + std::to_string(i);
         return false;
       }
       out.length[node] = len;
-      i = (size_t)(res.ptr - s);
+      i = (size_t)(endp - s);
     }
 
     if (open.empty()) {

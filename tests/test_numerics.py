@@ -50,3 +50,59 @@ def test_rerun_determinism():
     b = inst.evaluate(tree, full=True)
     c = inst.evaluate(tree, full=True)
     assert a == b == c
+
+
+def test_bf16x3_child_dot_bound():
+    """The fast path's default child-contraction precision (HIGH, 3-pass
+    bf16) must stay inside the NUMERICS.md bound.  Emulated exactly as
+    the MXU decomposes it: bf16 hi/lo split of both operands, hi*hi +
+    hi*lo + lo*hi, f32 accumulation — applied ONLY to the child CLV
+    contractions (P construction and root eval stay full precision)."""
+    import functools
+
+    import numpy as np
+
+    from examl_tpu.ops import fastpath as fp
+
+    orig_dg = jax.lax.dot_general
+
+    def bf16x3(x, p):
+        xh = x.astype(jnp.bfloat16).astype(jnp.float32)
+        xl = (x - xh).astype(jnp.bfloat16).astype(jnp.float32)
+        ph = p.astype(jnp.bfloat16).astype(jnp.float32)
+        plo = (p - ph).astype(jnp.bfloat16).astype(jnp.float32)
+        dn = (((3,), (2,)), ((0, 1), (0, 1)))
+        d = functools.partial(orig_dg, dimension_numbers=dn)
+        return d(xh, ph) + d(xh, plo) + d(xl, ph)
+
+    def patched(lhs, rhs, dimension_numbers, precision=None, **kw):
+        if (dimension_numbers == (((3,), (2,)), ((0, 1), (0, 1)))
+                and lhs.ndim == 4 and lhs.dtype == jnp.float32):
+            return bf16x3(lhs, rhs)
+        return orig_dg(lhs, rhs, dimension_numbers, precision=precision,
+                       **kw)
+
+    inst = default_instance(f"{TESTDATA}/49", f"{TESTDATA}/49.model",
+                            dtype=jnp.float32)
+    with open(f"{TESTDATA}/49.tree") as f:
+        tree = inst.tree_from_newick(f.read())
+    exact = float(inst.evaluate(tree, full=True))
+
+    eng = inst.engines[4]
+    root, entries = tree.full_traversal_centroid()
+    sched = eng._fast_schedule(entries)
+    jax.lax.dot_general = patched
+    fp.jax.lax.dot_general = patched
+    try:
+        clv, sc = fp.run_chunks(eng.models, eng.block_part, eng.tips,
+                                jnp.array(eng.clv), jnp.array(eng.scaler),
+                                sched.chunks, eng.scale_exp,
+                                jax.lax.Precision.HIGHEST)
+    finally:
+        jax.lax.dot_general = orig_dg
+        fp.jax.lax.dot_general = orig_dg
+    eng.clv, eng.scaler = clv, sc
+    eng._install_row_map(sched)
+    mixed = float(np.sum(eng.evaluate(root.number, root.back.number,
+                                      root.z)))
+    assert abs(mixed - exact) < 0.01, (mixed, exact)
