@@ -241,6 +241,10 @@ def run_search(args, inst, files: RunFiles) -> int:
         do_cutoff=args.mode != "o",
         search_convergence=args.rf_convergence,
         log=log)
+    from examl_tpu.search.spr import batched_scan_enabled
+    files.info("SPR lazy-arm scan: "
+               + ("batched (one dispatch per pruned node)"
+                  if batched_scan_enabled(inst) else "sequential"))
     conv = (RfConvergence(inst.alignment.ntaxa, log=files.info)
             if args.rf_convergence else None)
     if conv is not None and resume is not None:

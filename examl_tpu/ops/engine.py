@@ -251,7 +251,7 @@ class LikelihoodEngine:
 
     # -- traversal ---------------------------------------------------------
 
-    def _traversal_arrays(self, entries: List[TraversalEntry]) -> Traversal:
+    def _pack_traversal(self, entries, parent_row, gidx) -> Traversal:
         """Wave-schedule entries into [L, W] with a capped wave width.
 
         Waves wider than `wave_width` are chunked over several steps (their
@@ -259,7 +259,10 @@ class LikelihoodEngine:
         W.  This keeps padding waste ~W/2 entries per wave while collapsing
         the sequential step count from len(entries) to ~len(waves).  W is a
         capped power of two and L is size-bucketed (_bucket_len) so only
-        O(log n) compiled variants exist."""
+        O(log n) compiled variants exist.  parent_row/gidx map an entry's
+        parent to its arena row and a child id to its gather index (normal
+        traversals use the row_map; the batched scan targets its scratch
+        region)."""
         from examl_tpu.tree.topology import Tree
         raw = Tree.schedule_waves(entries)
         cap = self.wave_width
@@ -278,15 +281,19 @@ class LikelihoodEngine:
         zr = np.ones((L, W, C), dtype=np.float64)
         for li, wave in enumerate(waves):
             for wi, e in enumerate(wave):
-                parent[li, wi] = self.row_map[e.parent]
-                left[li, wi] = self._gidx(e.left)
-                right[li, wi] = self._gidx(e.right)
+                parent[li, wi] = parent_row(e)
+                left[li, wi] = gidx(e.left)
+                right[li, wi] = gidx(e.right)
                 zl[li, wi, :] = _z_slots(e.zl, C)
                 zr[li, wi, :] = _z_slots(e.zr, C)
         return Traversal(parent=jnp.asarray(parent), left=jnp.asarray(left),
                          right=jnp.asarray(right),
                          zl=jnp.asarray(zl, dtype=self.dtype),
                          zr=jnp.asarray(zr, dtype=self.dtype))
+
+    def _traversal_arrays(self, entries: List[TraversalEntry]) -> Traversal:
+        return self._pack_traversal(
+            entries, lambda e: self.row_map[e.parent], self._gidx)
 
     def _gidx(self, num: int) -> int:
         """gather_child index of a node: tips by code slot, inner nodes by
@@ -409,6 +416,90 @@ class LikelihoodEngine:
         jit around the fast path (bench.py, perf lab)."""
         return self._run_chunks_impl(self.models, self.block_part,
                                      self.tips, clv, scaler, chunks)
+
+    # -- batched SPR radius scan (search/batchscan.py) ----------------------
+
+    def ensure_scan_rows(self, n: int) -> int:
+        """Grow the arena by a scratch scan region of >= n rows (pow2
+        bucketed so reallocation and recompilation stay O(log n) over a
+        search); returns the region's base row.  The fast path and the
+        normal traversals never touch rows above their original arena, so
+        the region is free scratch between scan dispatches."""
+        if self.save_memory:
+            raise RuntimeError("batched scan is unavailable under -S "
+                               "(SEV pools have no scan region)")
+        if not hasattr(self, "_scan_base"):
+            self._scan_base = self.num_rows
+            self._scan_cap = 0
+        if n > self._scan_cap:
+            grow = _next_pow2(n) - self._scan_cap
+            pad = jnp.zeros((grow,) + self.clv.shape[1:], self.clv.dtype)
+            self.clv = jnp.concatenate([self.clv, pad])
+            spad = jnp.zeros((grow,) + self.scaler.shape[1:],
+                             self.scaler.dtype)
+            self.scaler = jnp.concatenate([self.scaler, spad])
+            self._scan_cap += grow
+            self.num_rows += grow
+            if self.sharding is not None:
+                self.clv = jax.device_put(self.clv, self.sharding.clv)
+                self.scaler = jax.device_put(self.scaler,
+                                             self.sharding.scaler)
+        return self._scan_base
+
+    def _scan_traversal_arrays(self, up_entries, base: int):
+        """Wave-schedule uppass entries into Traversal arrays writing the
+        scan region.  Slot ids are encoded above the node-number range so
+        Tree.schedule_waves resolves slot->slot dependencies; tree-node
+        children sit at level 0 (their down-CLVs are already valid)."""
+        from examl_tpu.tree.topology import TraversalEntry
+
+        SLOT0 = 2 * self.ntips + 1
+
+        def ref_id(ref):
+            kind, v = ref
+            return SLOT0 + v if kind == "slot" else v
+
+        pseudo = [TraversalEntry(SLOT0 + e.slot, ref_id(e.left),
+                                 ref_id(e.right), e.zl, e.zr)
+                  for e in up_entries]
+
+        def gidx(ident: int) -> int:
+            if ident >= SLOT0:
+                return self.ntips + base + (ident - SLOT0)
+            return self._gidx(ident)
+
+        return self._pack_traversal(
+            pseudo, lambda e: base + (e.parent - SLOT0), gidx)
+
+    def batched_scan(self, plan) -> np.ndarray:
+        """Uppass traversal + all candidate insertion scores in one
+        dispatch; returns this engine's per-candidate lnL sums [N]."""
+        from examl_tpu.search import batchscan
+
+        base = self.ensure_scan_rows(len(plan.up_entries))
+        tv = self._scan_traversal_arrays(plan.up_entries, base)
+        N = len(plan.candidates)
+        T = batchscan.CAND_CHUNK
+        n_chunks = max(1, _next_pow2((N + T - 1) // T))
+        npad = n_chunks * T
+        C = self.num_branch_slots
+        qg = np.zeros(npad, np.int32)
+        upg = np.zeros(npad, np.int32)
+        zc = np.ones((npad, C), dtype=np.float64)
+        for i, c in enumerate(plan.candidates):
+            qg[i] = self._gidx(c.q_num)
+            upg[i] = self.ntips + base + c.up_slot
+            zc[i] = _z_slots(c.z, C)
+        fn = batchscan.scan_program(self, n_chunks)
+        zp = jnp.asarray(_z_slots(plan.zp, C), dtype=self.dtype)
+        self.clv, self.scaler, lnls = fn(
+            self.clv, self.scaler, tv,
+            jnp.asarray(qg.reshape(n_chunks, T)),
+            jnp.asarray(upg.reshape(n_chunks, T)),
+            jnp.asarray(zc.reshape(n_chunks, T, C), dtype=self.dtype),
+            jnp.int32(self._gidx(plan.s_num)), zp,
+            self.models, self.block_part, self.weights, self.tips)
+        return np.asarray(lnls)[:N]
 
     def _fast_fn(self, profile, with_eval: bool):
         key = (profile, with_eval)

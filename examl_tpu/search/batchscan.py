@@ -1,0 +1,246 @@
+"""Batched SPR radius scan: every candidate insertion in ONE dispatch.
+
+TPU-native re-architecture of the reference's per-candidate insertion
+loop (ExaML `addTraverseBIG`/`testInsertBIG`, `searchAlgo.c:682-833`):
+the reference pays one newview + one evaluate round-trip per candidate
+branch; on TPU each round-trip is dominated by dispatch latency, so the
+scan is restructured around directional CLVs:
+
+* after `remove_node` the tree is conceptually rooted at the merged
+  branch (q1, q2).  Every candidate edge (v, parent(v)) needs
+  `down(v)` — v's CLV away from the merged edge, maintained by the
+  x-flag machinery — and `uppass(v)` — the CLV at parent(v) directed
+  away from v, folding in everything on the far side of the edge;
+* `uppass` obeys the same recurrence as newview:
+      uppass(v) = P_{z(w,pw)} uppass(w) ⊙ P_{z(w,sib)} down(sib)
+  for w = parent(v), pw = parent(w) — so the window's uppass vectors
+  are just MORE newview entries, wave-scheduled into a scratch region
+  of the CLV arena and computed by the SAME traversal kernel;
+* the lazy insertion score at (v, parent(v)) with the sqrt-branch rule
+  z' = clip(sqrt(z_v)) (reference `insertBIG` lazy arm) is
+      lnL = root_eval( P_{z_p} down(subtree) ⊙ P_{z'} down(v),
+                       uppass(v), z' )
+  which batches over all candidates as one wave.
+
+One jitted program per shape bucket runs the uppass traversal AND the
+batched scoring: one device dispatch per pruned node, versus
+O(candidates) round-trips in the reference.
+
+The candidate SET matches `addTraverseBIG`'s full radius window; the
+reference's lnL-cutoff additionally skips descendants of bad branches
+mid-scan (a CPU-cost heuristic, `searchAlgo.c:710-742`) — the batched
+scan evaluates the whole window (a superset: never loses a move the
+sequential scan would have found) and feeds the same per-insertion
+statistics to the cutoff auto-tuner.
+"""
+
+from __future__ import annotations
+
+from typing import List, NamedTuple, Optional, Tuple
+
+import numpy as np
+
+from examl_tpu.constants import ZMAX, ZMIN
+from examl_tpu.tree.topology import Node, Tree
+
+
+class Candidate(NamedTuple):
+    q_slot: Node            # slot of the edge's far end (q_slot.back = parent)
+    up_slot: int            # scan-slot index of uppass(q)
+    z: tuple                # candidate branch vector (sqrt rule, clipped)
+    depth: int              # edges from the merged branch (>= 1)
+
+    @property
+    def q_num(self) -> int:
+        return self.q_slot.number
+
+
+class UpEntry(NamedTuple):
+    """uppass(slot) = P_{zl}·left ⊙ P_{zr}·right; left/right reference
+    either a tree node ("node", number) or an earlier slot ("slot", s)."""
+    slot: int
+    left: Tuple[str, int]
+    right: Tuple[str, int]
+    zl: tuple
+    zr: tuple
+
+
+class ScanPlan(NamedTuple):
+    down_entries: list          # TraversalEntry list (orientation fixes)
+    up_entries: List[UpEntry]
+    candidates: List[Candidate]
+    s_num: int                  # subtree CLV node (p.back)
+    zp: tuple                   # branch vector p -- subtree
+
+
+def _zt(z) -> tuple:
+    return tuple(float(x) for x in np.asarray(z, dtype=np.float64))
+
+
+def plan_for_endpoints(inst, tree: Tree, p: Node, q1: Node, q2: Node,
+                       mintrav: int, maxtrav: int, constraint=None,
+                       pruned_clusters=None) -> Optional[ScanPlan]:
+    """Build the scan plan after remove_node(p) joined q1 -- q2.
+
+    The descent mirrors `rearrangeBIG`/`addTraverseBIG`: from each
+    non-tip endpoint, the two windows rooted at its children, testing
+    each edge (v, parent v) once mintrav is consumed, stopping at tips
+    or when maxtrav runs out.  Iterative (explicit stack) so deep scan
+    radii cannot hit the recursion limit.
+    """
+    from examl_tpu.utils import z_slots
+
+    C = inst.num_branch_slots
+
+    def sqrt_z(z) -> tuple:
+        return tuple(np.clip(np.sqrt(z_slots(z, C)), ZMIN, ZMAX))
+
+    def allowed(v: Node) -> bool:
+        if constraint is None:
+            return True
+        return constraint.insertion_ok(p, v, pruned_clusters)
+
+    up_entries: List[UpEntry] = []
+    candidates: List[Candidate] = []
+    gather_nodes: List[Node] = []       # nodes whose down-CLV is read
+    zqr = _zt(q1.z)
+
+    roots: List[Tuple[Node, int, int, int, int]] = []
+    for a, b in ((q1, q2), (q2, q1)):
+        if tree.is_tip(a.number):
+            continue
+        for child_link, sib_link in ((a.next, a.next.next),
+                                     (a.next.next, a.next)):
+            child, sib = child_link.back, sib_link.back
+            slot = len(up_entries)
+            # root uppass: CLV at a away from child
+            up_entries.append(UpEntry(
+                slot, ("node", b.number), ("node", sib.number),
+                zqr, _zt(sib_link.z)))
+            gather_nodes.append(b)
+            gather_nodes.append(sib)
+            roots.append((child, slot, 1, mintrav - 1, maxtrav - 1))
+
+    # Candidate order replicates addTraverseBIG's recursion (test the
+    # edge, then the v.next subtree, then v.next.next): the order decides
+    # which move wins exact lnL ties and when end_lh rises for the
+    # cutoff statistics, so it must match the sequential scan.
+    for item in roots:
+        stack = [item]
+        while stack:
+            v, up_slot, depth, mint, maxt = stack.pop()
+            if mint <= 0 and allowed(v):
+                candidates.append(Candidate(v, up_slot, sqrt_z(v.z),
+                                            depth))
+                gather_nodes.append(v)
+            if tree.is_tip(v.number) or maxt <= 0:
+                continue
+            pushes = []
+            for child_link, sib_link in ((v.next, v.next.next),
+                                         (v.next.next, v.next)):
+                child, sib = child_link.back, sib_link.back
+                slot = len(up_entries)
+                up_entries.append(UpEntry(
+                    slot, ("slot", up_slot), ("node", sib.number),
+                    _zt(v.z), _zt(sib_link.z)))
+                gather_nodes.append(sib)
+                pushes.append((child, slot, depth + 1, mint - 1,
+                               maxt - 1))
+            stack.extend(reversed(pushes))   # LIFO: v.next pops first
+
+    if not candidates:
+        return None
+
+    # Down-CLV orientation: every gathered node must view away from the
+    # merged edge; compute_traversal resolves staleness via the x-flags
+    # (dedup by parent -- windows overlap heavily).
+    need = {}
+    subtree_root = p.back
+    for v in gather_nodes + [subtree_root]:
+        if tree.is_tip(v.number):
+            continue
+        for e in tree.compute_traversal(v, full=False):
+            need.setdefault(e.parent, e)
+
+    return ScanPlan(down_entries=list(need.values()),
+                    up_entries=up_entries, candidates=candidates,
+                    s_num=subtree_root.number, zp=_zt(p.z))
+
+
+def run_plan(inst, tree: Tree, plan: ScanPlan) -> np.ndarray:
+    """Execute the plan; returns per-candidate total lnL [N].
+
+    Orientation entries go through the normal traversal path (they are
+    typically few — the window was just touched by makenewz); the
+    uppass+scoring program is the one dispatch per pruned node.
+    """
+    inst.run_traversal(plan.down_entries)
+    N = len(plan.candidates)
+    total = np.zeros(N, dtype=np.float64)
+    for eng in inst.engines.values():
+        total += np.asarray(eng.batched_scan(plan), dtype=np.float64)
+    return total
+
+
+# -- device side ------------------------------------------------------------
+
+CAND_CHUNK = 16
+
+
+def scan_program(eng, n_chunks: int):
+    """Build (or fetch) the jitted uppass+scoring program for one
+    candidate-chunk count.  Traversal shape variation is handled inside
+    by the engine's bucketed traversal arrays."""
+    import jax
+    import jax.numpy as jnp
+
+    from examl_tpu.ops import kernels
+
+    key = ("scan", n_chunks)
+    fn = eng._fast_jit_cache.get(key)
+    if fn is not None:
+        return fn
+
+    scale_exp = eng.scale_exp
+    ntips = eng.ntips
+
+    def impl(clv, scaler, tv, qg, upg, zc, sg, zp, dm, block_part,
+             weights, tips):
+        clv, scaler = kernels.traverse(dm, block_part, tips, clv, scaler,
+                                       tv, scale_exp, ntips, None)
+        xs, ss = kernels.gather_child(tips, clv, scaler, sg, ntips)
+        u = kernels.apply_p(kernels.p_matrices(dm, zp), block_part, xs)
+
+        minlik, two_e, _ = kernels.scale_constants(clv.dtype, scale_exp)
+        acc = kernels._acc_dtype(clv.dtype)
+        _, _, log_min = kernels.scale_constants(acc, scale_exp)
+
+        def chunk(carry, args):
+            qg_c, upg_c, z_c = args                       # [T], [T], [T,C]
+            xq, sq = kernels.gather_child(tips, clv, scaler, qg_c, ntips)
+            pw = kernels.p_matrices_wave(dm, z_c)         # [T,M,R,K,K]
+            pwb = pw[:, block_part]                       # [T,B,R,K,K]
+            t = kernels.einsum("tbrak,tblrk->tblra", pwb, xq)
+            v = t * u[None]
+            vmax = jnp.max(jnp.abs(v), axis=(3, 4))       # [T,B,l]
+            needs = vmax < minlik
+            v = jnp.where(needs[:, :, :, None, None], v * two_e, v)
+            sc_v = sq + ss[None] + needs.astype(jnp.int32)
+            xr, sr = kernels.gather_child(tips, clv, scaler, upg_c, ntips)
+            y = kernels.einsum("tbrak,tblrk->tblra", pwb, xr)
+            fb = dm.freqs[block_part]                     # [B,R,K]
+            wb = dm.rate_weights[block_part]              # [B,R]
+            lsite = kernels.einsum("brk,br,tblrk,tblrk->tbl",
+                                   fb, wb, v, y)
+            lsite = jnp.maximum(lsite, jnp.finfo(lsite.dtype).tiny)
+            sc = (sc_v + sr).astype(acc)
+            site_lnl = weights.astype(acc)[None] * (
+                jnp.log(lsite).astype(acc) + sc * log_min)
+            return carry, jnp.sum(site_lnl, axis=(1, 2))  # [T]
+
+        _, lnls = jax.lax.scan(chunk, 0, (qg, upg, zc))
+        return clv, scaler, lnls.reshape(-1)
+
+    fn = jax.jit(impl, donate_argnums=(0, 1))
+    eng._fast_jit_cache[key] = fn
+    return fn

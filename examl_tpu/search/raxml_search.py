@@ -18,7 +18,8 @@ from examl_tpu.instance import PhyloInstance
 from examl_tpu.optimize.branch import tree_evaluate
 from examl_tpu.optimize.model_opt import mod_opt
 from examl_tpu.search.snapshots import BestList, InfoList
-from examl_tpu.search.spr import (SprContext, dfs_slot_order, rearrange,
+from examl_tpu.search.spr import (SprContext, dfs_slot_order,
+                                  rearrange_auto as rearrange,
                                   restore_tree_fast, save_candidate_topology)
 from examl_tpu.tree.topology import Tree
 

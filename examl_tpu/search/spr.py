@@ -377,3 +377,97 @@ def dfs_slot_order(tree: Tree) -> List[Node]:
         stack.append(s.next.back)
     tips = [tree.nodep[i] for i in range(1, tree.ntips + 1)]
     return tips + inner
+
+
+def batched_scan_enabled(inst: PhyloInstance) -> bool:
+    """True when the lazy arm uses the one-dispatch-per-pruned-node scan
+    (search/batchscan.py); PSR and -S engines keep the sequential
+    primitives, EXAML_BATCH_SCAN=0 forces them everywhere."""
+    import os
+    if os.environ.get("EXAML_BATCH_SCAN", "1") == "0":
+        return False
+    if getattr(inst, "psr", False):
+        return False
+    return not any(getattr(e, "save_memory", False)
+                   for e in inst.engines.values())
+
+
+def rearrange_batched(inst: PhyloInstance, tree: Tree, ctx: SprContext,
+                      p: Node, mintrav: int, maxtrav: int) -> bool:
+    """`rearrange` with the lazy arm's candidate scoring batched into one
+    device dispatch per pruned node (search/batchscan.py): identical ctx
+    contract — best_of_node/end_lh/insert/remove/current_zqr and the
+    cutoff statistics — with the whole radius window evaluated (the
+    sequential scan's mid-descent cutoff stops are a CPU-cost heuristic;
+    the batched window is a superset, so no move is ever missed).
+    """
+    from examl_tpu.search import batchscan
+
+    if maxtrav < 1 or mintrav > maxtrav:
+        return False
+
+    def scan_one(prune: Node, mintrav_: int) -> None:
+        p1 = prune.next.back
+        p2 = prune.next.next.back
+        p1z = list(p1.z)
+        p2z = list(p2.z)
+        remove_node(inst, tree, ctx, prune)
+        plan = batchscan.plan_for_endpoints(
+            inst, tree, prune, p1, p2, mintrav_, maxtrav,
+            ctx.constraint, ctx.pruned_clusters)
+        if plan is not None:
+            lnls = batchscan.run_plan(inst, tree, plan)
+            for cand, lnl in zip(plan.candidates, lnls):
+                lnl = float(lnl)
+                # test_insert's contract: start_lh is the CURRENT end_lh
+                # at each candidate (it rises mid-window), so the cutoff
+                # statistics feed the same auto-tuning as the sequential
+                # scan (`searchAlgo.c:710-742`).
+                start_lh = ctx.end_lh
+                if lnl > ctx.best_of_node:
+                    ctx.best_of_node = lnl
+                    ctx.insert_node = cand.q_slot
+                    ctx.remove_node = prune
+                    ctx.current_zqr = ctx.zqr.copy()
+                if lnl > ctx.end_lh:
+                    ctx.insert_node = cand.q_slot
+                    ctx.remove_node = prune
+                    ctx.current_zqr = ctx.zqr.copy()
+                    ctx.end_lh = lnl
+                if ctx.do_cutoff and lnl < start_lh:
+                    ctx.lh_avg += start_lh - lnl
+                    ctx.lh_dec += 1
+        hookup(prune.next, p1, p1z)
+        hookup(prune.next.next, p2, p2z)
+        inst.new_view(tree, prune)
+
+    q = p.back
+    if not tree.is_tip(p.number):
+        p1 = p.next.back
+        p2 = p.next.next.back
+        if not tree.is_tip(p1.number) or not tree.is_tip(p2.number):
+            scan_one(p, mintrav)
+
+    if not tree.is_tip(q.number) and maxtrav > 0:
+        q1 = q.next.back
+        q2 = q.next.next.back
+
+        def has_depth(x: Node) -> bool:
+            return (not tree.is_tip(x.number)
+                    and (not tree.is_tip(x.next.back.number)
+                         or not tree.is_tip(x.next.next.back.number)))
+
+        if has_depth(q1) or has_depth(q2):
+            scan_one(q, max(mintrav, 2))
+    return True
+
+
+def rearrange_auto(inst: PhyloInstance, tree: Tree, ctx: SprContext,
+                   p: Node, mintrav: int, maxtrav: int) -> bool:
+    """Dispatch-latency-aware rearrange: the batched radius scan for the
+    lazy arm (one device program per pruned node), the sequential
+    primitives for thorough mode (per-candidate Newton-Raphson) and for
+    engine configurations without a scan region (PSR, -S)."""
+    if ctx.thorough or not batched_scan_enabled(inst):
+        return rearrange(inst, tree, ctx, p, mintrav, maxtrav)
+    return rearrange_batched(inst, tree, ctx, p, mintrav, maxtrav)

@@ -136,7 +136,9 @@ bool scan_newick(const char *s, size_t n, Scan &out) {
 #else
       char *endp_m = nullptr;
       len = strtod(s + j, &endp_m);
-      bool bad = (endp_m == s + j);
+      /* match from_chars' result_out_of_range handling: 1e999 etc. must
+       * be a parse error, not a silent +/-inf branch length */
+      bool bad = (endp_m == s + j) || !std::isfinite(len);
       const char *endp = endp_m;
 #endif
       if (bad) {

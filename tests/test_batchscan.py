@@ -1,0 +1,88 @@
+"""Batched SPR radius scan vs sequential test-insertion scoring.
+
+Every candidate lnL from the one-dispatch batched scan must match the
+sequential insert -> evaluate -> undo loop (reference `testInsertBIG`
+semantics) to float64 tolerance on CPU.
+"""
+
+import numpy as np
+import pytest
+
+from examl_tpu.instance import PhyloInstance
+from examl_tpu.io.alignment import build_alignment_data
+from examl_tpu.search import batchscan, spr
+from examl_tpu.tree.topology import hookup
+
+
+def _instance(ntaxa=14, nsites=400, seed=0, datatype="DNA"):
+    rng = np.random.default_rng(seed)
+    alphabet = {"AA": "ARNDCQEGHILKMFPSTWYV", "DNA": "ACGT"}[datatype]
+    names = [f"t{i}" for i in range(ntaxa)]
+    seqs = ["".join(alphabet[c]
+                    for c in rng.integers(0, len(alphabet), nsites))
+            for _ in names]
+    ad = build_alignment_data(names, seqs, datatype_name=datatype)
+    return PhyloInstance(ad)
+
+
+def _sequential_scores(inst, tree, ctx, p, plan):
+    """Score each plan candidate exactly like spr.test_insert's lazy arm."""
+    out = []
+    for cand in plan.candidates:
+        q = cand.q_slot          # the exact edge slot the plan scored
+        r = q.back
+        qz = list(q.z)
+        spr.insert_node(inst, tree, ctx, p, q)
+        lnl = inst.evaluate(tree, p.next.next)
+        hookup(q, r, qz)
+        p.next.back = None
+        p.next.next.back = None
+        out.append(lnl)
+    return np.asarray(out)
+
+
+@pytest.mark.parametrize("datatype,seed", [("DNA", 0), ("AA", 1)])
+def test_batched_scan_matches_sequential(datatype, seed):
+    inst = _instance(seed=seed, datatype=datatype,
+                     nsites=300 if datatype == "AA" else 400)
+    tree = inst.random_tree(seed)
+    inst.evaluate(tree, full=True)
+    ctx = spr.SprContext(inst, thorough=False, do_cutoff=False)
+
+    # a pruned node with structure on both sides
+    p = None
+    for num in tree.inner_numbers():
+        cand = tree.nodep[num]
+        if (not tree.is_tip(cand.next.back.number)
+                and not tree.is_tip(cand.next.next.back.number)):
+            p = cand
+            break
+    assert p is not None
+    q1 = p.next.back
+    q2 = p.next.next.back
+    spr.remove_node(inst, tree, ctx, p)
+
+    plan = batchscan.plan_for_endpoints(inst, tree, p, q1, q2,
+                                        mintrav=1, maxtrav=5)
+    assert plan is not None and len(plan.candidates) >= 4
+    batched = batchscan.run_plan(inst, tree, plan)
+    sequential = _sequential_scores(inst, tree, ctx, p, plan)
+    np.testing.assert_allclose(batched, sequential, rtol=1e-9, atol=1e-6)
+
+
+def test_batched_scan_window_respects_radius():
+    inst = _instance(ntaxa=20, nsites=200, seed=3)
+    tree = inst.random_tree(3)
+    inst.evaluate(tree, full=True)
+    ctx = spr.SprContext(inst, thorough=False)
+    p = next(tree.nodep[n] for n in tree.inner_numbers()
+             if not tree.is_tip(tree.nodep[n].next.back.number)
+             and not tree.is_tip(tree.nodep[n].next.next.back.number))
+    q1, q2 = p.next.back, p.next.next.back
+    spr.remove_node(inst, tree, ctx, p)
+    deep = batchscan.plan_for_endpoints(inst, tree, p, q1, q2, 1, 10)
+    shallow = batchscan.plan_for_endpoints(inst, tree, p, q1, q2, 1, 2)
+    assert max(c.depth for c in shallow.candidates) <= 2
+    assert len(shallow.candidates) < len(deep.candidates)
+    mint2 = batchscan.plan_for_endpoints(inst, tree, p, q1, q2, 2, 10)
+    assert min(c.depth for c in mint2.candidates) >= 2
