@@ -136,12 +136,13 @@ bool scan_newick(const char *s, size_t n, Scan &out) {
 #else
       char *endp_m = nullptr;
       len = strtod(s + j, &endp_m);
-      /* match from_chars' result_out_of_range handling: 1e999 etc. must
-       * be a parse error, not a silent +/-inf branch length */
-      bool bad = (endp_m == s + j) || !std::isfinite(len);
+      bool bad = (endp_m == s + j);
       const char *endp = endp_m;
 #endif
-      if (bad) {
+      /* both parsers must reject non-finite lengths the same way:
+       * out-of-range (1e999) and literal inf/nan forms are parse errors,
+       * never silent +/-inf branch lengths in the likelihood code */
+      if (bad || !std::isfinite(len)) {
         out.error = "bad branch length at " + std::to_string(i);
         return false;
       }
