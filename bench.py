@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import json
 import os
+import sys
 import time
 
 import numpy as np
@@ -112,33 +113,46 @@ def main() -> None:
     # Auto-tune across the available fast-path variants (plain-XLA chunk
     # pipeline vs the fused Pallas kernels) the way the reference picks
     # its fastest ISA backend; report the winner.
-    def chained_fn():
+    def chained_fn(body_step):
         @jax.jit
         def chained(clv, scaler):
             def body(_, cs):
-                return eng.run_chunks_traced(cs[0], cs[1], chunks)
+                return body_step(cs[0], cs[1])
             clv, scaler = jax.lax.fori_loop(0, n_steps, body, (clv, scaler))
             return jnp.sum(scaler)
         return chained
 
-    variants = [("xla", False)]
-    if eng.use_pallas or (
-            eng._want_pallas and eng.sharding is None
-            and eng.dtype == jnp.float32
-            and next(iter(eng.clv.devices())).platform in ("tpu", "axon")):
-        variants.append(("pallas", True))
-    dt, variant = 1e18, "xla"
-    for name, flag in variants:
-        eng.use_pallas = flag
-        fn = chained_fn()
-        float(fn(eng.clv, eng.scaler))       # compile + warm
+    def chunks_step(use_pallas):
+        def step(clv, scaler):
+            eng.use_pallas = use_pallas
+            return eng.run_chunks_traced(clv, scaler, chunks)
+        return step
+
+    variants = [("xla", chunks_step(False))]
+    if eng.use_pallas:               # the engine's own placement decision
+        from examl_tpu.ops import pallas_whole
+        wsched = pallas_whole.build_flat(entries, eng.ntips,
+                                         eng.num_branch_slots)
+        variants.append(("pallas", chunks_step(True)))
+        variants.append(("pallas-whole",
+                         lambda c, s: eng.run_whole_traced(c, s, wsched)))
+    dt, variant = None, None
+    for name, step in variants:
+        try:
+            fn = chained_fn(step)
+            float(fn(eng.clv, eng.scaler))       # compile + warm
+        except Exception as exc:                 # noqa: BLE001
+            sys.stderr.write(f"bench: variant {name} failed: {exc}\n")
+            continue
         for _ in range(3):
             t0 = time.perf_counter()
             float(fn(eng.clv, eng.scaler))
             d = time.perf_counter() - t0
-            if d < dt:
+            if dt is None or d < dt:
                 dt, variant = d, name
-    eng.use_pallas = (variant == "pallas")
+    if dt is None:
+        raise RuntimeError("no traversal variant ran successfully")
+    eng.use_pallas = (variant in ("pallas", "pallas-whole"))
 
     patterns = sum(p.width for p in inst.alignment.partitions)
     rates, states = eng.R, eng.K

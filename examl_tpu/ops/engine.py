@@ -143,8 +143,12 @@ class LikelihoodEngine:
         self.sharding = sharding
         self.pallas_interpret = _pos.environ.get(
             "EXAML_PALLAS_INTERPRET", "") == "1"
-        self._want_pallas = _pos.environ.get("EXAML_PALLAS", "1") != "0"
+        # EXAML_PALLAS: 0 = off, 1 = per-chunk kernels (default),
+        # whole = one kernel per full traversal (ops/pallas_whole.py).
+        self._pallas_env = _pos.environ.get("EXAML_PALLAS", "1")
+        self._want_pallas = self._pallas_env != "0"
         self.use_pallas = False        # decided once tensors are placed
+        self.pallas_whole = False
 
         lane = bucket.lane
         B = bucket.num_blocks
@@ -195,6 +199,8 @@ class LikelihoodEngine:
                 and sharding is None
                 and (self.pallas_interpret
                      or platform in ("tpu", "axon")))
+            self.pallas_whole = (self.use_pallas
+                                 and self._pallas_env == "whole")
 
         # One jitted traversal program; jax recompiles per padded entry-count
         # shape (powers of two, so only a handful of variants exist).  The
@@ -313,6 +319,9 @@ class LikelihoodEngine:
         if not entries:
             return
         if full and self._fast_eligible(entries):
+            if self.pallas_whole:
+                self._run_whole(entries)
+                return
             sched = self._fast_schedule(entries)
             fn = self._fast_fn(sched.profile, with_eval=False)
             data = tuple((c.base, c.lidx, c.ridx, c.lcode, c.rcode,
@@ -416,6 +425,71 @@ class LikelihoodEngine:
         jit around the fast path (bench.py, perf lab)."""
         return self._run_chunks_impl(self.models, self.block_part,
                                      self.tips, clv, scaler, chunks)
+
+    # -- whole-traversal Pallas path (ops/pallas_whole.py) ------------------
+
+    def _whole_fn(self, E: int, with_eval: bool):
+        key = ("whole", E, with_eval)
+        fn = self._fast_jit_cache.get(key)
+        if fn is not None:
+            return fn
+        from examl_tpu.ops import pallas_whole
+
+        def run(clv, scaler, meta, lc, rc, zl, zr, dm, bp, tips):
+            return pallas_whole.run_flat_arrays(
+                dm, bp, tips, clv, scaler, E, meta, lc, rc, zl, zr,
+                self.scale_exp, self.fast_precision,
+                self.pallas_interpret)
+
+        def impl_eval(clv, scaler, meta, lc, rc, zl, zr, p_idx, q_idx,
+                      zv, dm, bp, weights, tips):
+            clv, scaler = run(clv, scaler, meta, lc, rc, zl, zr, dm, bp,
+                              tips)
+            lnl = kernels.root_log_likelihood(
+                dm, bp, weights, tips, clv, scaler, p_idx, q_idx, zv,
+                self.num_parts, self.scale_exp, self.ntips, None)
+            return clv, scaler, lnl
+
+        fn = jax.jit(impl_eval if with_eval else run,
+                     donate_argnums=(0, 1))
+        self._fast_jit_cache[key] = fn
+        return fn
+
+    def _whole_args(self, entries):
+        from examl_tpu.ops import pallas_whole
+        sched = pallas_whole.build_flat(entries, self.ntips,
+                                        self.num_branch_slots)
+        return sched, (jnp.asarray(sched.meta),
+                       jnp.asarray(sched.l_code),
+                       jnp.asarray(sched.r_code),
+                       jnp.asarray(sched.zl, dtype=self.dtype),
+                       jnp.asarray(sched.zr, dtype=self.dtype))
+
+    def _run_whole(self, entries, p_num=None, q_num=None, z=None):
+        sched, args = self._whole_args(entries)
+        self._install_row_map(sched)
+        if p_num is None:
+            fn = self._whole_fn(sched.e_real, with_eval=False)
+            self.clv, self.scaler = fn(self.clv, self.scaler, *args,
+                                       self.models, self.block_part,
+                                       self.tips)
+            return None
+        fn = self._whole_fn(sched.e_real, with_eval=True)
+        zv = jnp.asarray(_z_slots(z, self.num_branch_slots),
+                         dtype=self.dtype)
+        self.clv, self.scaler, out = fn(
+            self.clv, self.scaler, *args, jnp.int32(self._gidx(p_num)),
+            jnp.int32(self._gidx(q_num)), zv, self.models,
+            self.block_part, self.weights, self.tips)
+        return np.asarray(out)
+
+    def run_whole_traced(self, clv, scaler, sched):
+        """Traceable whole-traversal execution for external harnesses
+        (bench.py): schedule built once on host, kernel traced inline."""
+        from examl_tpu.ops import pallas_whole
+        return pallas_whole.run_flat(
+            self.models, self.block_part, self.tips, clv, scaler, sched,
+            self.scale_exp, self.fast_precision, self.pallas_interpret)
 
     # -- batched SPR radius scan (search/batchscan.py) ----------------------
 
@@ -568,6 +642,8 @@ class LikelihoodEngine:
                           q_num: int, z: Sequence[float],
                           full: bool = False) -> np.ndarray:
         if full and entries and self._fast_eligible(entries):
+            if self.pallas_whole:
+                return self._run_whole(entries, p_num, q_num, z)
             sched = self._fast_schedule(entries)
             fn = self._fast_fn(sched.profile, with_eval=True)
             data = tuple((c.base, c.lidx, c.ridx, c.lcode, c.rcode,

@@ -85,3 +85,54 @@ def test_engine_full_traversal_pallas(monkeypatch):
     tree2 = inst2.random_tree(4)
     lnl_pal = inst2.evaluate(tree2, full=True)
     assert lnl_pal == pytest.approx(lnl_ref, abs=5e-3)
+
+
+def test_whole_traversal_matches_fastpath():
+    """Stage-2 whole-traversal kernel (ops/pallas_whole.py): same CLVs
+    and scalers as the chunked fast path, modulo row layout and f32
+    rounding from the algebraically-equivalent tip expansion order."""
+    from examl_tpu.ops import pallas_whole
+
+    inst = _instance("AA", 24, 300)
+    tree = inst.random_tree(1)
+    eng = inst.engines[20]
+    _, entries = tree.full_traversal_centroid()
+    fsched = eng._fast_schedule(entries)
+    ref_clv, ref_sc = fastpath.run_chunks(
+        eng.models, eng.block_part, eng.tips, jnp.array(eng.clv),
+        jnp.array(eng.scaler), fsched.chunks, eng.scale_exp,
+        eng.fast_precision)
+    wsched = pallas_whole.build_flat(entries, eng.ntips,
+                                     eng.num_branch_slots)
+    w_clv, w_sc = pallas_whole.run_flat(
+        eng.models, eng.block_part, eng.tips, jnp.array(eng.clv),
+        jnp.array(eng.scaler), wsched, eng.scale_exp, interpret=True)
+    ref_clv, ref_sc = np.asarray(ref_clv), np.asarray(ref_sc)
+    w_clv, w_sc = np.asarray(w_clv), np.asarray(w_sc)
+    for num, frow in fsched.row_of.items():
+        wrow = wsched.row_of[num]
+        np.testing.assert_allclose(ref_clv[frow], w_clv[wrow],
+                                   rtol=1e-4, atol=1e-6)
+        np.testing.assert_array_equal(ref_sc[frow], w_sc[wrow])
+
+
+def test_engine_whole_mode(monkeypatch):
+    """EXAML_PALLAS=whole routes full traversals (and the fused
+    traverse+evaluate) through the single-kernel path; lnL must match."""
+    inst = _instance("DNA", 20, 500, seed=5)
+    tree = inst.random_tree(5)
+    lnl_ref = inst.evaluate(tree, full=True)
+
+    monkeypatch.setenv("EXAML_PALLAS", "whole")
+    monkeypatch.setenv("EXAML_PALLAS_INTERPRET", "1")
+    inst2 = _instance("DNA", 20, 500, seed=5)
+    eng2 = inst2.engines[4]
+    assert eng2.pallas_whole
+    tree2 = inst2.random_tree(5)
+    lnl_w = inst2.evaluate(tree2, full=True)
+    assert lnl_w == pytest.approx(lnl_ref, abs=5e-3)
+    # partial traversals after a full one read through the flat row map
+    p = tree2.nodep[30]
+    inst2.makenewz(tree2, p, p.back, list(p.z), maxiter=8)
+    lnl3 = inst2.evaluate(tree2)
+    assert lnl3 >= lnl_w - 1e-3
