@@ -153,6 +153,7 @@ def main() -> None:
     if dt is None:
         raise RuntimeError("no traversal variant ran successfully")
     eng.use_pallas = (variant in ("pallas", "pallas-whole"))
+    eng.pallas_whole = (variant == "pallas-whole")
 
     patterns = sum(p.width for p in inst.alignment.partitions)
     rates, states = eng.R, eng.K

@@ -82,6 +82,8 @@ def build_argparser() -> argparse.ArgumentParser:
     ap.add_argument("--auto-prot", dest="auto_prot", default="ml",
                     choices=["ml", "bic", "aic", "aicc"],
                     help="criterion for AUTO protein model selection")
+    from examl_tpu.parallel.launch import add_launch_args
+    add_launch_args(ap)
     return ap
 
 
@@ -91,7 +93,12 @@ class RunFiles:
     On a -R restart, existing info/log files are appended to, preserving
     the interrupted run's history (the reference appends likewise)."""
 
-    def __init__(self, workdir: str, run_id: str, append: bool = False):
+    def __init__(self, workdir: str, run_id: str, append: bool = False,
+                 primary: bool = True):
+        """primary=False (non-zero process of a multi-host job) computes
+        the same SPMD program but writes NO output files — the
+        reference's processID==0 gating (`axml.c`, every print site)."""
+        self.primary = primary
         os.makedirs(workdir, exist_ok=True)
         pre = os.path.join(workdir, "ExaML_")
         self.info_path = f"{pre}info.{run_id}"
@@ -102,11 +109,13 @@ class RunFiles:
         self.quartets_path = f"{pre}quartets.{run_id}"
         self.start_time = time.time()
         self._phases = {}
-        if not append:
+        if not append and primary:
             for p in (self.info_path, self.log_path):
                 open(p, "w").close()
 
     def info(self, msg: str) -> None:
+        if not self.primary:
+            return
         print(msg)
         with open(self.info_path, "a") as f:
             f.write(msg + "\n")
@@ -136,10 +145,14 @@ class RunFiles:
         self.info(f"  {'total':24s} {total:10.2f} s")
 
     def log_lnl(self, lnl: float) -> None:
+        if not self.primary:
+            return
         with open(self.log_path, "a") as f:
             f.write(f"{time.time() - self.start_time:.6f} {lnl:.6f}\n")
 
     def write_result(self, text: str) -> None:
+        if not self.primary:
+            return
         with open(self.result_path, "w") as f:
             f.write(text if text.endswith("\n") else text + "\n")
 
@@ -403,15 +416,31 @@ def _packing_report(inst, files: RunFiles) -> None:
 
 def main(argv=None) -> int:
     args = build_argparser().parse_args(argv)
-    files = RunFiles(args.workdir, args.run_id, append=args.restart)
+
+    from examl_tpu.instance import PhyloInstance
+    from examl_tpu.parallel.launch import init_distributed, select_sharding
+
+    # Join the multi-host job BEFORE any output: only process 0 writes
+    # run files (the reference's processID==0 gating); other processes
+    # compute the same SPMD program with their files diverted to a
+    # per-process scratch dir so nothing clobbers.
+    init_distributed(args, log=print)
+    primary = True
+    if args.nprocs is not None or args.coordinator is not None:
+        import jax
+        primary = jax.process_index() == 0
+        if not primary:
+            args.workdir = os.path.join(args.workdir,
+                                        f".proc{jax.process_index()}")
+    files = RunFiles(args.workdir, args.run_id, append=args.restart,
+                     primary=primary)
     files.info("examl-tpu: TPU-native maximum likelihood inference "
                "(capability parity with ExaML 3.0.22)")
     files.info(f"alignment: {args.bytefile}  mode: -f {args.mode}  "
                f"model: {args.model}")
 
-    from examl_tpu.instance import PhyloInstance
-
     with files.phase("startup (io + engines)"):
+        sharding = select_sharding(args, args.save_memory, log=files.info)
         data = _load_alignment(args.bytefile)
         files.info(f"{data.ntaxa} taxa, {data.total_patterns} patterns, "
                    f"{len(data.partitions)} partitions")
@@ -420,7 +449,8 @@ def main(argv=None) -> int:
             data, ncat=4, use_median=args.median,
             per_partition_branches=args.per_partition_bl,
             rate_model=args.model, psr_categories=args.categories,
-            save_memory=args.save_memory)
+            save_memory=args.save_memory, sharding=sharding,
+            block_multiple=(sharding.num_devices if sharding else 1))
         inst.auto_prot_criterion = args.auto_prot
         _packing_report(inst, files)
 

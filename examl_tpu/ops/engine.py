@@ -467,20 +467,30 @@ class LikelihoodEngine:
 
     def _run_whole(self, entries, p_num=None, q_num=None, z=None):
         sched, args = self._whole_args(entries)
-        self._install_row_map(sched)
+
+        def gidx_new(num: int) -> int:
+            # against the NEW layout, WITHOUT installing it yet: a Mosaic
+            # failure below must not leave the row map pointing at rows
+            # the arena does not hold.
+            if num <= self.ntips:
+                return num - 1
+            return self.ntips + sched.row_of[num]
+
         if p_num is None:
             fn = self._whole_fn(sched.e_real, with_eval=False)
             self.clv, self.scaler = fn(self.clv, self.scaler, *args,
                                        self.models, self.block_part,
                                        self.tips)
+            self._install_row_map(sched)
             return None
         fn = self._whole_fn(sched.e_real, with_eval=True)
         zv = jnp.asarray(_z_slots(z, self.num_branch_slots),
                          dtype=self.dtype)
         self.clv, self.scaler, out = fn(
-            self.clv, self.scaler, *args, jnp.int32(self._gidx(p_num)),
-            jnp.int32(self._gidx(q_num)), zv, self.models,
+            self.clv, self.scaler, *args, jnp.int32(gidx_new(p_num)),
+            jnp.int32(gidx_new(q_num)), zv, self.models,
             self.block_part, self.weights, self.tips)
+        self._install_row_map(sched)
         return np.asarray(out)
 
     def run_whole_traced(self, clv, scaler, sched):

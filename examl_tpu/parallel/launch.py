@@ -1,0 +1,82 @@
+"""Process/device launch: the reference's MPI startup, TPU-native.
+
+ExaML starts as `mpirun -np N examl ...` — MPI_Init, rank discovery,
+and per-rank site assignment (`axml.c: main`, `communication.c:120-182`).
+The TPU equivalent has two layers:
+
+* **multi-host**: `jax.distributed.initialize(coordinator, nprocs,
+  procid)` joins this process to the cluster; afterwards `jax.devices()`
+  is the GLOBAL device list and every process runs the same SPMD
+  program.  Driven by `--coordinator/--nprocs/--procid` or the standard
+  cluster env (JAX auto-detects on supported platforms when flags are
+  omitted but --nprocs > 1).
+* **single-host, multi-device**: no init needed; the site axis simply
+  shards over the local mesh.
+
+Either way the result is one 1-D "sites" mesh over all visible chips
+(`parallel/sharding.py`); per-site tensors shard, the tree/model stay
+replicated, and the lnL/derivative reductions become XLA collectives —
+the reference's Allreduce, inserted by the compiler.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from examl_tpu.parallel.sharding import (SiteSharding, make_mesh,
+                                         site_sharding)
+
+
+def add_launch_args(parser) -> None:
+    g = parser.add_argument_group("distributed launch")
+    g.add_argument("--coordinator", default=None,
+                   help="coordinator address host:port for multi-host "
+                        "runs (jax.distributed)")
+    g.add_argument("--nprocs", type=int, default=None,
+                   help="number of processes in the multi-host job")
+    g.add_argument("--procid", type=int, default=None,
+                   help="this process's index in the multi-host job")
+    g.add_argument("--single-device", action="store_true",
+                   help="disable site-axis sharding even when several "
+                        "devices are visible")
+
+
+def init_distributed(args, log=lambda msg: None) -> None:
+    """Join the multi-host job when requested; no-op otherwise."""
+    if args.coordinator is None and args.nprocs is None:
+        return
+    import jax
+
+    kwargs = {}
+    if args.coordinator is not None:
+        kwargs["coordinator_address"] = args.coordinator
+    if args.nprocs is not None:
+        kwargs["num_processes"] = args.nprocs
+    if args.procid is not None:
+        kwargs["process_id"] = args.procid
+    jax.distributed.initialize(**kwargs)
+    log(f"distributed: process {jax.process_index()} of "
+        f"{jax.process_count()}, {jax.local_device_count()} local / "
+        f"{jax.device_count()} global devices")
+
+
+def select_sharding(args, save_memory: bool,
+                    log=lambda msg: None) -> Optional[SiteSharding]:
+    """A site-axis sharding over every visible device, or None for the
+    single-device (or -S, which keeps its CLV pool host-resident) case."""
+    if getattr(args, "single_device", False):
+        return None
+    import jax
+
+    n = len(jax.devices())
+    if n <= 1:
+        return None
+    if save_memory:
+        log("-S (SEV) does not compose with site-axis sharding; "
+            "running on one device (drop -S to use all "
+            f"{n} devices)")
+        return None
+    sh = site_sharding(make_mesh())
+    log(f"site axis sharded over {n} devices "
+        f"({jax.process_count()} process(es))")
+    return sh

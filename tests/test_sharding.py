@@ -115,3 +115,42 @@ def test_mesh_shapes():
     mesh = make_mesh(n_devices=8)
     sh = site_sharding(mesh)
     assert sh.num_devices == 8
+
+
+def test_cli_auto_shards_over_devices(tmp_path):
+    """The CLI shards the site axis over every visible device by default
+    (the reference's mpirun -np N surface) and the result matches a
+    --single-device run."""
+    import re
+
+    from examl_tpu.cli.main import main as cli_main
+    from examl_tpu.io.alignment import build_alignment_data
+    from examl_tpu.io.bytefile import write_bytefile
+
+    rng = np.random.default_rng(7)
+    cur = rng.integers(0, 4, 600)
+    seqs = []
+    for _ in range(12):
+        flip = rng.random(600) < 0.2
+        cur = np.where(flip, rng.integers(0, 4, 600), cur)
+        seqs.append("".join("ACGT"[c] for c in cur))
+    data = build_alignment_data([f"t{i}" for i in range(12)], seqs)
+    write_bytefile(str(tmp_path / "a.binary"), data)
+    inst = PhyloInstance(data)
+    t = inst.random_tree(seed=3)
+    (tmp_path / "start.nwk").write_text(t.to_newick(data.taxon_names))
+
+    def run(extra, tag):
+        wd = str(tmp_path / tag)
+        rc = cli_main(["-s", str(tmp_path / "a.binary"), "-t",
+                       str(tmp_path / "start.nwk"), "-n", tag, "-f", "e",
+                       "-w", wd] + extra)
+        assert rc == 0
+        info = open(f"{wd}/ExaML_info.{tag}").read()
+        m = re.findall(r"Likelihood tree 0: (-[\d.]+)", info)
+        return float(m[0]), info
+
+    lnl_multi, info_multi = run([], "MULTI")
+    assert "sharded over 8 devices" in info_multi
+    lnl_single, _ = run(["--single-device"], "SINGLE")
+    assert lnl_multi == pytest.approx(lnl_single, abs=2e-4)
