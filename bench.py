@@ -63,15 +63,24 @@ def _ensure_live_backend() -> None:
 
     if os.environ.get("EXAML_BENCH_NO_PROBE"):
         return
-    try:
-        proc = subprocess.run(
-            [sys.executable, "-c",
-             "import jax; jax.devices(); "
-             "import jax.numpy as jnp; jnp.zeros(2).block_until_ready()"],
-            env=os.environ, capture_output=True, timeout=240)
-        ok = proc.returncode == 0
-    except subprocess.TimeoutExpired:
-        ok = False
+    ok = False
+    # Two tries: a flaky tunnel can heal between them.  The first keeps
+    # the original 240s budget so a slow-but-healthy cold init is never
+    # misclassified; the retry is shorter.
+    for attempt, budget in enumerate((240, 120)):
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-c",
+                 "import jax; jax.devices(); "
+                 "import jax.numpy as jnp; jnp.zeros(2).block_until_ready()"],
+                env=os.environ, capture_output=True, timeout=budget)
+            ok = proc.returncode == 0
+        except subprocess.TimeoutExpired:
+            ok = False
+        if ok:
+            break
+        if attempt == 0:            # no dead wait after the final try
+            time.sleep(30)
     if ok:
         return
     sys.stderr.write("bench: default backend unusable; falling back to "

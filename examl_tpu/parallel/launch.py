@@ -44,6 +44,11 @@ def add_launch_args(parser) -> None:
 def init_distributed(args, log=lambda msg: None) -> None:
     """Join the multi-host job when requested; no-op otherwise."""
     if args.coordinator is None and args.nprocs is None:
+        if args.procid is not None:
+            raise ValueError(
+                "--procid requires --nprocs/--coordinator: without them "
+                "this process would run as a second primary and clobber "
+                "process 0's output files")
         return
     import jax
 

@@ -176,13 +176,38 @@ def compute_quartets(inst: PhyloInstance, tree: Tree, opts: QuartetOptions,
     q1 = tree.nodep[n + 1]
     q2 = tree.nodep[n + 2]
 
+    from examl_tpu.search import quartets_batch
+
+    use_batch = quartets_batch.batch_eligible(inst)
+    log("quartet scoring: "
+        + ("batched on-device (quartets x topologies per dispatch)"
+           if use_batch else "sequential"))
+    buf: List[tuple] = []
+
     counter = 0
     with open(out_path, "a") as f:
+
+        def flush() -> None:
+            """Score and write buffered sets (row-identical to the
+            sequential scorer, reference output format)."""
+            if not buf:
+                return
+            jobs = [j for s in buf
+                    for j in quartets_batch.three_topology_jobs(*s)]
+            lnls = quartets_batch.score_jobs(inst, jobs)
+            k = 0
+            for s in buf:
+                for a, b, c, d in quartets_batch.three_topology_jobs(*s):
+                    f.write(f"{a} {b} | {c} {d}: {lnls[k]:f}\n")
+                    k += 1
+            buf.clear()
+
         for t1, t2, t3, t4 in _quartet_sets(inst, opts):
             if counter >= start_counter:
                 if (opts.checkpoint_mgr is not None
                         and counter != start_counter
                         and counter % opts.checkpoint_interval == 0):
+                    flush()
                     f.flush()
                     opts.checkpoint_mgr.write(
                         "QUARTETS",
@@ -190,6 +215,13 @@ def compute_quartets(inst: PhyloInstance, tree: Tree, opts: QuartetOptions,
                          "file_position": f.tell(),
                          "seed": opts.seed},
                         inst, tree, tree_dict=base_tree_dict)
-                _three_topologies(inst, tree, q1, q2, t1, t2, t3, t4, f)
+                if use_batch:
+                    buf.append((t1, t2, t3, t4))
+                    if 3 * len(buf) >= quartets_batch.JOB_CHUNK:
+                        flush()
+                else:
+                    _three_topologies(inst, tree, q1, q2, t1, t2, t3, t4,
+                                      f)
             counter += 1
+        flush()
     return counter

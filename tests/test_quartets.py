@@ -108,3 +108,27 @@ def test_random_quartet_sampling(tmp_path, inst8):
     assert n >= 10                              # counter includes skipped
     lines = [l for l in open(out) if "|" in l]
     assert len(lines) == 30
+
+
+def test_batched_scorer_matches_sequential(inst8):
+    """quartets_batch.score_jobs reproduces the sequential NNI-smoothed
+    topology lnLs (same smoothing passes, same Newton semantics)."""
+    import io
+
+    from examl_tpu.search import quartets_batch
+    from examl_tpu.search.quartets import _three_topologies
+
+    inst = inst8
+    tree = inst.random_tree(seed=2)
+    inst.evaluate(tree, full=True)
+    n = inst.alignment.ntaxa
+    q1, q2 = tree.nodep[n + 1], tree.nodep[n + 2]
+    sets = [(1, 2, 3, 4), (2, 5, 7, 8), (1, 6, 7, 8)]
+    out = io.StringIO()
+    for s in sets:
+        _three_topologies(inst, tree, q1, q2, *s, out)
+    seq = [float(r.split(": ")[1])
+           for r in out.getvalue().strip().split("\n")]
+    jobs = [j for s in sets for j in quartets_batch.three_topology_jobs(*s)]
+    got = quartets_batch.score_jobs(inst, jobs)
+    np.testing.assert_allclose(got, seq, rtol=1e-6, atol=5e-4)
