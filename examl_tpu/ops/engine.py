@@ -530,11 +530,13 @@ class LikelihoodEngine:
                                              self.sharding.scaler)
         return self._scan_base
 
-    def _scan_traversal_arrays(self, up_entries, base: int):
-        """Wave-schedule uppass entries into Traversal arrays writing the
-        scan region.  Slot ids are encoded above the node-number range so
-        Tree.schedule_waves resolves slot->slot dependencies; tree-node
-        children sit at level 0 (their down-CLVs are already valid)."""
+    def _scan_traversal_arrays(self, down_entries, up_entries, base: int):
+        """Wave-schedule the orientation fixes AND the uppass entries into
+        ONE set of Traversal arrays (one traverse, one dispatch).  Slot
+        ids are encoded above the node-number range so Tree.schedule_waves
+        resolves node->node, node->slot, and slot->slot dependencies
+        uniformly; down entries write normal arena rows through the row
+        map, up entries write the scan region."""
         from examl_tpu.tree.topology import TraversalEntry
 
         SLOT0 = 2 * self.ntips + 1
@@ -543,17 +545,22 @@ class LikelihoodEngine:
             kind, v = ref
             return SLOT0 + v if kind == "slot" else v
 
-        pseudo = [TraversalEntry(SLOT0 + e.slot, ref_id(e.left),
-                                 ref_id(e.right), e.zl, e.zr)
-                  for e in up_entries]
+        pseudo = list(down_entries) + [
+            TraversalEntry(SLOT0 + e.slot, ref_id(e.left),
+                           ref_id(e.right), e.zl, e.zr)
+            for e in up_entries]
+
+        def parent_row(e) -> int:
+            if e.parent >= SLOT0:
+                return base + (e.parent - SLOT0)
+            return self.row_map[e.parent]
 
         def gidx(ident: int) -> int:
             if ident >= SLOT0:
                 return self.ntips + base + (ident - SLOT0)
             return self._gidx(ident)
 
-        return self._pack_traversal(
-            pseudo, lambda e: base + (e.parent - SLOT0), gidx)
+        return self._pack_traversal(pseudo, parent_row, gidx)
 
     def batched_scan(self, plan) -> np.ndarray:
         """Uppass traversal + all candidate insertion scores in one
@@ -561,7 +568,8 @@ class LikelihoodEngine:
         from examl_tpu.search import batchscan
 
         base = self.ensure_scan_rows(len(plan.up_entries))
-        tv = self._scan_traversal_arrays(plan.up_entries, base)
+        tv = self._scan_traversal_arrays(plan.down_entries,
+                                         plan.up_entries, base)
         N = len(plan.candidates)
         T = batchscan.CAND_CHUNK
         n_chunks = max(1, _next_pow2((N + T - 1) // T))

@@ -153,7 +153,12 @@ def plan_for_endpoints(inst, tree: Tree, p: Node, q1: Node, q2: Node,
 
     # Down-CLV orientation: every gathered node must view away from the
     # merged edge; compute_traversal resolves staleness via the x-flags
-    # (dedup by parent -- windows overlap heavily).
+    # (dedup by parent -- windows overlap heavily).  The deduped union
+    # must then be DEPENDENCY-SORTED: compute_traversal always recomputes
+    # its top node, so a later call can emit a rewrite of a node that an
+    # earlier call's entry reads -- list order alone would let
+    # schedule_waves place the reader at or before the writer and gather
+    # a stale CLV.
     need = {}
     subtree_root = p.back
     for v in gather_nodes + [subtree_root]:
@@ -162,7 +167,28 @@ def plan_for_endpoints(inst, tree: Tree, p: Node, q1: Node, q2: Node,
         for e in tree.compute_traversal(v, full=False):
             need.setdefault(e.parent, e)
 
-    return ScanPlan(down_entries=list(need.values()),
+    down_entries: list = []
+    emitted = set()
+
+    def emit(entry) -> None:
+        stack = [(entry, False)]
+        while stack:
+            e, expanded = stack.pop()
+            if e.parent in emitted:
+                continue
+            if expanded:
+                emitted.add(e.parent)
+                down_entries.append(e)
+                continue
+            stack.append((e, True))
+            for child in (e.left, e.right):
+                if child in need and child not in emitted:
+                    stack.append((need[child], False))
+
+    for e in need.values():
+        emit(e)
+
+    return ScanPlan(down_entries=down_entries,
                     up_entries=up_entries, candidates=candidates,
                     s_num=subtree_root.number, zp=_zt(p.z))
 
@@ -170,11 +196,9 @@ def plan_for_endpoints(inst, tree: Tree, p: Node, q1: Node, q2: Node,
 def run_plan(inst, tree: Tree, plan: ScanPlan) -> np.ndarray:
     """Execute the plan; returns per-candidate total lnL [N].
 
-    Orientation entries go through the normal traversal path (they are
-    typically few — the window was just touched by makenewz); the
-    uppass+scoring program is the one dispatch per pruned node.
+    Orientation fixes, uppass traversal, and all candidate scores run as
+    ONE device program per engine — one dispatch per pruned node.
     """
-    inst.run_traversal(plan.down_entries)
     N = len(plan.candidates)
     total = np.zeros(N, dtype=np.float64)
     for eng in inst.engines.values():

@@ -86,3 +86,39 @@ def test_batched_scan_window_respects_radius():
     assert len(shallow.candidates) < len(deep.candidates)
     mint2 = batchscan.plan_for_endpoints(inst, tree, p, q1, q2, 2, 10)
     assert min(c.depth for c in mint2.candidates) >= 2
+
+
+def test_down_entries_dependency_ordered():
+    """Writers precede readers in the orientation-fix list: the scan's
+    single traverse must never gather a row rewritten later in the same
+    program (compute_traversal always recomputes its top node, so the
+    deduped union needs an explicit dependency sort)."""
+    inst = _instance(ntaxa=24, nsites=120, seed=9)
+    tree = inst.random_tree(9)
+    inst.evaluate(tree, full=True)
+    ctx = spr.SprContext(inst, thorough=False)
+    checked = 0
+    for num in tree.inner_numbers():
+        p = tree.nodep[num]
+        if (tree.is_tip(p.next.back.number)
+                or tree.is_tip(p.next.next.back.number)):
+            continue
+        q1, q2 = p.next.back, p.next.next.back
+        p1z, p2z = list(q1.z), list(q2.z)
+        spr.remove_node(inst, tree, ctx, p)
+        plan = batchscan.plan_for_endpoints(inst, tree, p, q1, q2, 1, 8)
+        if plan is not None:
+            written = {}
+            for i, e in enumerate(plan.down_entries):
+                written[e.parent] = i
+            for i, e in enumerate(plan.down_entries):
+                for child in (e.left, e.right):
+                    if child in written:
+                        assert written[child] < i, (child, e.parent)
+            checked += 1
+        hookup(p.next, q1, p1z)
+        hookup(p.next.next, q2, p2z)
+        inst.new_view(tree, p)
+        if checked >= 5:
+            break
+    assert checked >= 3
