@@ -562,6 +562,20 @@ class LikelihoodEngine:
 
         return self._pack_traversal(pseudo, parent_row, gidx)
 
+    def _scan_dispatch_arrays(self, plan, base: int, T: int):
+        """Shared padding/chunk plumbing for the scan programs: gather
+        indices for candidates and their uppass rows, padded to a pow2
+        number of T-wide chunks (O(log n) compiled variants)."""
+        N = len(plan.candidates)
+        n_chunks = max(1, _next_pow2((N + T - 1) // T))
+        npad = n_chunks * T
+        qg = np.zeros(npad, np.int32)
+        upg = np.zeros(npad, np.int32)
+        for i, c in enumerate(plan.candidates):
+            qg[i] = self._gidx(c.q_num)
+            upg[i] = self.ntips + base + c.up_slot
+        return n_chunks, npad, qg, upg
+
     def batched_scan(self, plan) -> np.ndarray:
         """Uppass traversal + all candidate insertion scores in one
         dispatch; returns this engine's per-candidate lnL sums [N]."""
@@ -570,17 +584,11 @@ class LikelihoodEngine:
         base = self.ensure_scan_rows(len(plan.up_entries))
         tv = self._scan_traversal_arrays(plan.down_entries,
                                          plan.up_entries, base)
-        N = len(plan.candidates)
         T = batchscan.CAND_CHUNK
-        n_chunks = max(1, _next_pow2((N + T - 1) // T))
-        npad = n_chunks * T
+        n_chunks, npad, qg, upg = self._scan_dispatch_arrays(plan, base, T)
         C = self.num_branch_slots
-        qg = np.zeros(npad, np.int32)
-        upg = np.zeros(npad, np.int32)
         zc = np.ones((npad, C), dtype=np.float64)
         for i, c in enumerate(plan.candidates):
-            qg[i] = self._gidx(c.q_num)
-            upg[i] = self.ntips + base + c.up_slot
             zc[i] = _z_slots(c.z, C)
         fn = batchscan.scan_program(self, n_chunks)
         zp = jnp.asarray(_z_slots(plan.zp, C), dtype=self.dtype)
@@ -591,7 +599,32 @@ class LikelihoodEngine:
             jnp.asarray(zc.reshape(n_chunks, T, C), dtype=self.dtype),
             jnp.int32(self._gidx(plan.s_num)), zp,
             self.models, self.block_part, self.weights, self.tips)
-        return np.asarray(lnls)[:N]
+        return np.asarray(lnls)[:len(plan.candidates)]
+
+    def batched_thorough(self, plan):
+        """Thorough-arm companion of `batched_scan`: triangle Newton,
+        localSmooth, and scoring per candidate in one dispatch; returns
+        (lnls [N], smoothed branch triplets [N, 3])."""
+        from examl_tpu.search import batchscan
+
+        base = self.ensure_scan_rows(len(plan.up_entries))
+        tv = self._scan_traversal_arrays(plan.down_entries,
+                                         plan.up_entries, base)
+        T = batchscan.TH_CHUNK
+        n_chunks, npad, qg, upg = self._scan_dispatch_arrays(plan, base, T)
+        zq0 = np.full(npad, float(np.asarray(plan.zp, np.float64)[0]))
+        for i, c in enumerate(plan.candidates):
+            zq0[i] = float(np.asarray(c.q_slot.z, np.float64)[0])
+        fn = batchscan.thorough_program(self, n_chunks)
+        self.clv, self.scaler, lnls, es = fn(
+            self.clv, self.scaler, tv,
+            jnp.asarray(qg.reshape(n_chunks, T)),
+            jnp.asarray(upg.reshape(n_chunks, T)),
+            jnp.asarray(zq0.reshape(n_chunks, T), dtype=self.dtype),
+            jnp.int32(self._gidx(plan.s_num)), self.models,
+            self.block_part, self.weights, self.tips)
+        N = len(plan.candidates)
+        return np.asarray(lnls)[:N], np.asarray(es)[:N]
 
     def _fast_fn(self, profile, with_eval: bool):
         key = (profile, with_eval)

@@ -40,7 +40,7 @@ from typing import List, NamedTuple, Optional, Tuple
 
 import numpy as np
 
-from examl_tpu.constants import ZMAX, ZMIN
+from examl_tpu.constants import DEFAULTZ, DELTAZ, ZMAX, ZMIN
 from examl_tpu.tree.topology import Node, Tree
 
 
@@ -268,3 +268,153 @@ def scan_program(eng, n_chunks: int):
     fn = jax.jit(impl, donate_argnums=(0, 1))
     eng._fast_jit_cache[key] = fn
     return fn
+
+
+# -- thorough arm -----------------------------------------------------------
+
+TH_CHUNK = 8
+
+
+def thorough_program(eng, n_chunks: int):
+    """Jitted thorough-insertion scorer: orientation+uppass traversal,
+    then per candidate the reference's full Thorough procedure
+    (`insertBIG` thorough arm + `localSmooth`, `searchAlgo.c:495-533`,
+    :196-436) in closed form:
+
+    * three pairwise Newton optimizations to convergence between
+      down(q), uppass(q), and the subtree CLV (the star triangle's
+      virtual branches), started like `_triangle_branches`;
+    * the log-space triangle solve with the reference's degenerate
+      caps;
+    * up to 32 localSmooth passes — each branch one Newton iteration
+      with the DELTAZ movement test — where the three CLVs around the
+      insertion node are closed-form products of P-applied operands
+      (no arena writes needed);
+    * the final evaluation across the r-side branch.
+
+    Newton derivatives are invariant to the operands' scaling counters
+    (a per-site constant factor), so only the final lnL applies them.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from examl_tpu.ops import kernels
+
+    key = ("thscan", n_chunks)
+    fn = eng._fast_jit_cache.get(key)
+    if fn is not None:
+        return fn
+
+    from examl_tpu.constants import SMOOTHINGS
+    from examl_tpu.search.spr import SPR_NR_ITERATIONS
+
+    scale_exp = eng.scale_exp
+    ntips = eng.ntips
+    lzmax = float(np.log(ZMAX))
+
+    def impl(clv, scaler, tv, qg, upg, zq0, sg, dm, block_part, weights,
+             tips):
+        clv, scaler = kernels.traverse(dm, block_part, tips, clv, scaler,
+                                       tv, scale_exp, ntips, None)
+        xs, ss = kernels.gather_child(tips, clv, scaler, sg, ntips)
+        minlik, two_e, _ = kernels.scale_constants(clv.dtype, scale_exp)
+        acc = kernels._acc_dtype(clv.dtype)
+        _, _, log_min = kernels.scale_constants(acc, scale_exp)
+
+        def papply(z, x):
+            return kernels.apply_p(kernels.p_matrices(dm, z[None]),
+                                   block_part, x)
+
+        def nr(xp, xq, z0, iters):
+            st = kernels.sumtable(dm, block_part, xp, xq)
+            return kernels.newton_raphson_branch(
+                dm, block_part, weights, st,
+                jnp.full(1, z0, dtype=clv.dtype),
+                jnp.full(1, iters, jnp.int32), jnp.zeros(1, bool), 1)[0]
+
+        def one(xq1, sq1, xr1, sr1, z01):
+            zqr = nr(xq1, xr1, z01, SPR_NR_ITERATIONS)
+            zqs = nr(xq1, xs, DEFAULTZ, SPR_NR_ITERATIONS)
+            zrs = nr(xr1, xs, DEFAULTZ, SPR_NR_ITERATIONS)
+            lzqr = jnp.log(jnp.maximum(zqr, ZMIN))
+            lzqs = jnp.log(jnp.maximum(zqs, ZMIN))
+            lzrs = jnp.log(jnp.maximum(zrs, ZMIN))
+            lzsum = 0.5 * (lzqr + lzqs + lzrs)
+            lzq, lzr, lzs = lzsum - lzrs, lzsum - lzqs, lzsum - lzqr
+            e1 = jnp.exp(lzq)
+            e2 = jnp.exp(lzr)
+            e3 = jnp.exp(lzs)
+            # degenerate triangles: reference's elif chain
+            c1 = lzq > lzmax
+            c2 = ~c1 & (lzr > lzmax)
+            c3 = ~c1 & ~c2 & (lzs > lzmax)
+            e1 = jnp.where(c1, ZMAX, jnp.where(c2, zqr,
+                           jnp.where(c3, zqs, e1)))
+            e2 = jnp.where(c1, zqr, jnp.where(c2, ZMAX,
+                           jnp.where(c3, zrs, e2)))
+            e3 = jnp.where(c1, zqs, jnp.where(c2, zrs,
+                           jnp.where(c3, ZMAX, e3)))
+
+            def body(state):
+                e1, e2, e3, it, done = state
+                moved = jnp.zeros((), bool)
+
+                def step(znew, zold, moved):
+                    znew = jnp.where(done, zold, znew)
+                    return znew, moved | (jnp.abs(znew - zold) > DELTAZ)
+
+                # localSmooth order: (p: e3), (p.next: e1), (p.next.next: e2)
+                slot_s = papply(e1, xq1) * papply(e2, xr1)
+                e3, moved = step(nr(slot_s, xs, e3, 1), e3, moved)
+                slot_q = papply(e2, xr1) * papply(e3, xs)
+                e1, moved = step(nr(slot_q, xq1, e1, 1), e1, moved)
+                slot_r = papply(e1, xq1) * papply(e3, xs)
+                e2, moved = step(nr(slot_r, xr1, e2, 1), e2, moved)
+                return e1, e2, e3, it + 1, done | ~moved
+
+            def cond(state):
+                _, _, _, it, done = state
+                return (it < SMOOTHINGS) & ~done
+
+            e1, e2, e3, _, _ = jax.lax.while_loop(
+                cond, body, (e1, e2, e3, jnp.zeros((), jnp.int32),
+                             jnp.zeros((), bool)))
+
+            xp = papply(e1, xq1) * papply(e3, xs)
+            needs = jnp.max(jnp.abs(xp), axis=(2, 3)) < minlik   # [B,l]
+            xp = jnp.where(needs[:, :, None, None], xp * two_e, xp)
+            scp = sq1 + ss + needs.astype(jnp.int32)
+            lsite = kernels.site_likelihoods(dm, block_part, xp, xr1,
+                                             e2[None])
+            lsite = jnp.maximum(lsite, jnp.finfo(lsite.dtype).tiny)
+            sc = (scp + sr1).astype(acc)
+            lnl = jnp.sum(weights.astype(acc)
+                          * (jnp.log(lsite).astype(acc) + sc * log_min))
+            return lnl, e1, e2, e3
+
+        def chunk(carry, args):
+            qg_c, upg_c, z0_c = args
+            xq, sq = kernels.gather_child(tips, clv, scaler, qg_c, ntips)
+            xr, sr = kernels.gather_child(tips, clv, scaler, upg_c, ntips)
+            lnl, e1, e2, e3 = jax.vmap(one)(xq, sq, xr, sr, z0_c)
+            return carry, (lnl, e1, e2, e3)
+
+        _, (lnls, e1, e2, e3) = jax.lax.scan(chunk, 0, (qg, upg, zq0))
+        return (clv, scaler, lnls.reshape(-1),
+                jnp.stack([e1.reshape(-1), e2.reshape(-1),
+                           e3.reshape(-1)], axis=1))
+
+    fn = jax.jit(impl, donate_argnums=(0, 1))
+    eng._fast_jit_cache[key] = fn
+    return fn
+
+
+def run_plan_thorough(inst, tree: Tree, plan: ScanPlan
+                      ) -> Tuple[np.ndarray, np.ndarray]:
+    """Thorough scores for every plan candidate: (lnls [N], e [N, 3])
+    with e = the smoothed (lzq, lzr, lzs) branch triplet per candidate.
+    Single-engine, single-branch-slot instances only (the caller
+    gates); the padding/chunk/dispatch plumbing lives on the engine
+    next to the lazy arm's (`LikelihoodEngine.batched_thorough`)."""
+    (eng,) = inst.engines.values()
+    return eng.batched_thorough(plan)

@@ -122,3 +122,72 @@ def test_down_entries_dependency_ordered():
         if checked >= 5:
             break
     assert checked >= 3
+
+
+def test_batched_thorough_matches_sequential():
+    """The thorough arm (triangle NR + localSmooth + evaluate) batched
+    on device must reproduce the sequential per-candidate lnLs and the
+    smoothed branch triplets."""
+    inst = _instance(ntaxa=12, nsites=350, seed=11)
+    tree = inst.random_tree(11)
+    inst.evaluate(tree, full=True)
+    ctx = spr.SprContext(inst, thorough=True, do_cutoff=False)
+
+    p = next(tree.nodep[n] for n in tree.inner_numbers()
+             if not tree.is_tip(tree.nodep[n].next.back.number)
+             and not tree.is_tip(tree.nodep[n].next.next.back.number))
+    q1, q2 = p.next.back, p.next.next.back
+    spr.remove_node(inst, tree, ctx, p)
+
+    plan = batchscan.plan_for_endpoints(inst, tree, p, q1, q2, 1, 4)
+    assert plan is not None and len(plan.candidates) >= 3
+    lnls, es = batchscan.run_plan_thorough(inst, tree, plan)
+
+    seq_lnls, seq_es = [], []
+    for cand in plan.candidates:
+        q = cand.q_slot
+        r = q.back
+        qz = list(q.z)
+        pz = list(p.z)
+        spr.insert_node(inst, tree, ctx, p, q)     # triangle + smooth
+        seq_lnls.append(inst.evaluate(tree, p.next.next))
+        seq_es.append((p.next.z[0], p.next.next.z[0], p.z[0]))
+        from examl_tpu.tree.topology import hookup as hk
+        hk(q, r, qz)
+        p.next.back = None
+        p.next.next.back = None
+        hk(p, p.back, pz)         # test_insert's thorough undo
+    np.testing.assert_allclose(lnls, seq_lnls, rtol=1e-9, atol=5e-4)
+    np.testing.assert_allclose(es, seq_es, rtol=1e-3, atol=1e-5)
+
+
+def test_thorough_gating(monkeypatch):
+    """Batched thorough is an accelerator-only default (whole-window
+    compute vs dispatch trade); EXAML_BATCH_THOROUGH forces it."""
+    from examl_tpu.search.spr import thorough_batched_ok
+
+    inst = _instance(ntaxa=8, nsites=100, seed=1)
+    assert not thorough_batched_ok(inst)          # CPU default: off
+    monkeypatch.setenv("EXAML_BATCH_THOROUGH", "1")
+    assert thorough_batched_ok(inst)
+    monkeypatch.setenv("EXAML_BATCH_THOROUGH", "0")
+    assert not thorough_batched_ok(inst)
+
+
+def test_thorough_e2e_cycle(monkeypatch):
+    """A small thorough SPR cycle with the batched arm forced improves
+    lnL like the sequential one."""
+    from examl_tpu.constants import UNLIKELY
+    from examl_tpu.search.raxml_search import tree_optimize_rapid
+    from examl_tpu.search.snapshots import BestList, InfoList
+
+    monkeypatch.setenv("EXAML_BATCH_THOROUGH", "1")
+    inst = _instance(ntaxa=10, nsites=250, seed=13)
+    tree = inst.random_tree(13)
+    lnl0 = inst.evaluate(tree, full=True)
+    ctx = spr.SprContext(inst, thorough=True)
+    bt = BestList(1)
+    ilist = InfoList(20)
+    out = tree_optimize_rapid(inst, tree, ctx, 1, 5, bt, None, ilist)
+    assert out > lnl0 + 1.0, (out, lnl0)
+    assert np.isfinite(inst.evaluate(tree, full=True))
