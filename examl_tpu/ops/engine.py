@@ -598,7 +598,8 @@ class LikelihoodEngine:
             jnp.asarray(upg.reshape(n_chunks, T)),
             jnp.asarray(zc.reshape(n_chunks, T, C), dtype=self.dtype),
             jnp.int32(self._gidx(plan.s_num)), zp,
-            self.models, self.block_part, self.weights, self.tips)
+            self.models, self.block_part, self.weights, self.tips,
+            self.site_rates)
         return np.asarray(lnls)[:len(plan.candidates)]
 
     def batched_thorough(self, plan):

@@ -214,7 +214,10 @@ CAND_CHUNK = 16
 def scan_program(eng, n_chunks: int):
     """Build (or fetch) the jitted uppass+scoring program for one
     candidate-chunk count.  Traversal shape variation is handled inside
-    by the engine's bucketed traversal arrays."""
+    by the engine's bucketed traversal arrays.  Under PSR the engine's
+    per-site rate multipliers ride along and every P application uses
+    the factorized per-site form (`apply_p_factorized`); the GAMMA path
+    keeps the batched P-matrix contraction."""
     import jax
     import jax.numpy as jnp
 
@@ -227,13 +230,19 @@ def scan_program(eng, n_chunks: int):
 
     scale_exp = eng.scale_exp
     ntips = eng.ntips
+    psr = eng.psr
 
     def impl(clv, scaler, tv, qg, upg, zc, sg, zp, dm, block_part,
-             weights, tips):
+             weights, tips, sr_rates):
         clv, scaler = kernels.traverse(dm, block_part, tips, clv, scaler,
-                                       tv, scale_exp, ntips, None)
+                                       tv, scale_exp, ntips, sr_rates)
         xs, ss = kernels.gather_child(tips, clv, scaler, sg, ntips)
-        u = kernels.apply_p(kernels.p_matrices(dm, zp), block_part, xs)
+        if psr:
+            ds = kernels.psr_decay(dm, block_part, sr_rates, zp)
+            u = kernels.apply_p_factorized(dm, block_part, ds, xs)
+        else:
+            u = kernels.apply_p(kernels.p_matrices(dm, zp), block_part,
+                                xs)
 
         minlik, two_e, _ = kernels.scale_constants(clv.dtype, scale_exp)
         acc = kernels._acc_dtype(clv.dtype)
@@ -242,16 +251,22 @@ def scan_program(eng, n_chunks: int):
         def chunk(carry, args):
             qg_c, upg_c, z_c = args                       # [T], [T], [T,C]
             xq, sq = kernels.gather_child(tips, clv, scaler, qg_c, ntips)
-            pw = kernels.p_matrices_wave(dm, z_c)         # [T,M,R,K,K]
-            pwb = pw[:, block_part]                       # [T,B,R,K,K]
-            t = kernels.einsum("tbrak,tblrk->tblra", pwb, xq)
+            xr, sr = kernels.gather_child(tips, clv, scaler, upg_c, ntips)
+            if psr:
+                d_c = jax.vmap(lambda zz: kernels.psr_decay(
+                    dm, block_part, sr_rates, zz))(z_c)   # [T,B,l,R,K]
+                t = kernels.apply_p_factorized(dm, block_part, d_c, xq)
+                y = kernels.apply_p_factorized(dm, block_part, d_c, xr)
+            else:
+                pw = kernels.p_matrices_wave(dm, z_c)     # [T,M,R,K,K]
+                pwb = pw[:, block_part]                   # [T,B,R,K,K]
+                t = kernels.einsum("tbrak,tblrk->tblra", pwb, xq)
+                y = kernels.einsum("tbrak,tblrk->tblra", pwb, xr)
             v = t * u[None]
             vmax = jnp.max(jnp.abs(v), axis=(3, 4))       # [T,B,l]
             needs = vmax < minlik
             v = jnp.where(needs[:, :, :, None, None], v * two_e, v)
             sc_v = sq + ss[None] + needs.astype(jnp.int32)
-            xr, sr = kernels.gather_child(tips, clv, scaler, upg_c, ntips)
-            y = kernels.einsum("tbrak,tblrk->tblra", pwb, xr)
             fb = dm.freqs[block_part]                     # [B,R,K]
             wb = dm.rate_weights[block_part]              # [B,R]
             lsite = kernels.einsum("brk,br,tblrk,tblrk->tbl",

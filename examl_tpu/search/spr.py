@@ -381,12 +381,11 @@ def dfs_slot_order(tree: Tree) -> List[Node]:
 
 def batched_scan_enabled(inst: PhyloInstance) -> bool:
     """True when the lazy arm uses the one-dispatch-per-pruned-node scan
-    (search/batchscan.py); PSR and -S engines keep the sequential
-    primitives, EXAML_BATCH_SCAN=0 forces them everywhere."""
+    (search/batchscan.py), GAMMA or PSR; -S engines keep the sequential
+    primitives (pools have no scan region), EXAML_BATCH_SCAN=0 forces
+    them everywhere."""
     import os
     if os.environ.get("EXAML_BATCH_SCAN", "1") == "0":
-        return False
-    if getattr(inst, "psr", False):
         return False
     return not any(getattr(e, "save_memory", False)
                    for e in inst.engines.values())
@@ -477,30 +476,14 @@ def rearrange_batched(inst: PhyloInstance, tree: Tree, ctx: SprContext,
     return True
 
 
-def rearrange_auto(inst: PhyloInstance, tree: Tree, ctx: SprContext,
-                   p: Node, mintrav: int, maxtrav: int) -> bool:
-    """Dispatch-latency-aware rearrange: one device program per pruned
-    node for both arms (lazy scoring, resp. thorough triangle-NR +
-    localSmooth + scoring); sequential primitives remain for engine
-    configurations without a scan region (PSR, -S) or where the batched
-    Newton loops cannot run on one device (mixed state buckets,
-    per-partition branches)."""
-    if ctx.thorough:
-        if thorough_batched_ok(inst):
-            return rearrange_batched_thorough(inst, tree, ctx, p,
-                                              mintrav, maxtrav)
-        return rearrange(inst, tree, ctx, p, mintrav, maxtrav)
-    if not batched_scan_enabled(inst):
-        return rearrange(inst, tree, ctx, p, mintrav, maxtrav)
-    return rearrange_batched(inst, tree, ctx, p, mintrav, maxtrav)
-
 
 def thorough_batched_ok(inst: PhyloInstance) -> bool:
     """The batched thorough arm additionally needs ONE state bucket and
     ONE branch slot: the triangle/smoothing Newton loops iterate on
     device, so mixed buckets (whose derivatives must sum across engines
     per iteration) and per-partition branch masks keep the sequential
-    primitives.
+    primitives; PSR keeps the sequential thorough arm too (the batched
+    triangle/smoothing uses the GAMMA P-matrix form).
 
     It is also gated to ACCELERATOR devices: it trades compute (the
     whole window, no cutoff early-outs) for dispatches, which wins where
@@ -512,11 +495,11 @@ def thorough_batched_ok(inst: PhyloInstance) -> bool:
     """
     import os
     forced = os.environ.get("EXAML_BATCH_THOROUGH")
-    if forced is not None:
-        if forced == "0":
-            return False
+    if forced == "0":
+        return False
     if not (batched_scan_enabled(inst) and len(inst.engines) == 1
-            and inst.num_branch_slots == 1):
+            and inst.num_branch_slots == 1
+            and not getattr(inst, "psr", False)):
         return False
     if forced == "1":
         return True
@@ -538,11 +521,13 @@ def rearrange_batched_thorough(inst: PhyloInstance, tree: Tree,
 def rearrange_auto(inst: PhyloInstance, tree: Tree, ctx: SprContext,
                    p: Node, mintrav: int, maxtrav: int) -> bool:
     """Dispatch-latency-aware rearrange: one device program per pruned
-    node for both arms (lazy scoring, resp. thorough triangle-NR +
-    localSmooth + scoring); sequential primitives remain for engine
-    configurations without a scan region (PSR, -S) or where the batched
-    Newton loops cannot run on one device (mixed state buckets,
-    per-partition branches)."""
+    node for both arms.  The lazy scan batches for GAMMA and PSR alike;
+    the thorough arm batches on accelerator devices for single-bucket,
+    single-slot GAMMA instances (thorough_batched_ok).  Sequential
+    primitives remain for -S (no scan region), for mixed state buckets
+    and per-partition branches (the on-device Newton loops cannot sum
+    derivatives across engines), and wherever the env switches force
+    them."""
     if ctx.thorough:
         if thorough_batched_ok(inst):
             return rearrange_batched_thorough(inst, tree, ctx, p,
@@ -551,104 +536,3 @@ def rearrange_auto(inst: PhyloInstance, tree: Tree, ctx: SprContext,
     if not batched_scan_enabled(inst):
         return rearrange(inst, tree, ctx, p, mintrav, maxtrav)
     return rearrange_batched(inst, tree, ctx, p, mintrav, maxtrav)
-
-
-def thorough_batched_ok(inst: PhyloInstance) -> bool:
-    """The batched thorough arm additionally needs ONE state bucket and
-    ONE branch slot: the triangle/smoothing Newton loops iterate on
-    device, so mixed buckets (whose derivatives must sum across engines
-    per iteration) and per-partition branch masks keep the sequential
-    primitives.
-
-    It is also gated to ACCELERATOR devices: it trades compute (the
-    whole window, no cutoff early-outs) for dispatches, which wins where
-    dispatch latency dominates (the TPU tunnel) and loses on host CPU,
-    where the sequential cutoff arm is cheaper.  EXAML_BATCH_THOROUGH=0
-    forces it off anywhere; =1 forces it on WHERE THE STRUCTURAL
-    REQUIREMENTS HOLD (one bucket, one slot, no PSR/-S) -- those are
-    hard constraints of the on-device Newton loops, not preferences.
-    """
-    import os
-    forced = os.environ.get("EXAML_BATCH_THOROUGH")
-    if forced is not None:
-        if forced == "0":
-            return False
-    if not (batched_scan_enabled(inst) and len(inst.engines) == 1
-            and inst.num_branch_slots == 1):
-        return False
-    if forced == "1":
-        return True
-    (eng,) = inst.engines.values()
-    if eng.clv is None:
-        return False
-    platform = next(iter(eng.clv.devices())).platform
-    return platform in ("tpu", "axon")
-
-
-def rearrange_batched_thorough(inst: PhyloInstance, tree: Tree,
-                               ctx: SprContext, p: Node, mintrav: int,
-                               maxtrav: int) -> bool:
-    """`rearrange` with the THOROUGH arm batched (search/batchscan.py
-    run_plan_thorough): per pruned node, one device program runs the
-    star-triangle Newton optimizations, the localSmooth passes, and the
-    evaluation for every candidate.  Same ctx contract as the
-    sequential thorough test_insert, including the smoothed branch
-    triplet (lzq/lzr/lzs) the restore path re-applies."""
-    from examl_tpu.search import batchscan
-
-    if maxtrav < 1 or mintrav > maxtrav:
-        return False
-
-    def scan_one(prune: Node, mintrav_: int) -> None:
-        p1 = prune.next.back
-        p2 = prune.next.next.back
-        p1z = list(p1.z)
-        p2z = list(p2.z)
-        remove_node(inst, tree, ctx, prune)
-        plan = batchscan.plan_for_endpoints(
-            inst, tree, prune, p1, p2, mintrav_, maxtrav,
-            ctx.constraint, ctx.pruned_clusters)
-        if plan is not None:
-            lnls, es = batchscan.run_plan_thorough(inst, tree, plan)
-            for cand, lnl, e in zip(plan.candidates, lnls, es):
-                lnl = float(lnl)
-                start_lh = ctx.end_lh
-                if lnl > ctx.best_of_node:
-                    ctx.best_of_node = lnl
-                    ctx.insert_node = cand.q_slot
-                    ctx.remove_node = prune
-                    ctx.current_zqr = ctx.zqr.copy()
-                    ctx.current_lzq = np.full_like(ctx.current_lzq, e[0])
-                    ctx.current_lzr = np.full_like(ctx.current_lzr, e[1])
-                    ctx.current_lzs = np.full_like(ctx.current_lzs, e[2])
-                if lnl > ctx.end_lh:
-                    ctx.insert_node = cand.q_slot
-                    ctx.remove_node = prune
-                    ctx.current_zqr = ctx.zqr.copy()
-                    ctx.end_lh = lnl
-                if ctx.do_cutoff and lnl < start_lh:
-                    ctx.lh_avg += start_lh - lnl
-                    ctx.lh_dec += 1
-        hookup(prune.next, p1, p1z)
-        hookup(prune.next.next, p2, p2z)
-        inst.new_view(tree, prune)
-
-    q = p.back
-    if not tree.is_tip(p.number):
-        p1 = p.next.back
-        p2 = p.next.next.back
-        if not tree.is_tip(p1.number) or not tree.is_tip(p2.number):
-            scan_one(p, mintrav)
-
-    if not tree.is_tip(q.number) and maxtrav > 0:
-        q1 = q.next.back
-        q2 = q.next.next.back
-
-        def has_depth(x: Node) -> bool:
-            return (not tree.is_tip(x.number)
-                    and (not tree.is_tip(x.next.back.number)
-                         or not tree.is_tip(x.next.next.back.number)))
-
-        if has_depth(q1) or has_depth(q2):
-            scan_one(q, max(mintrav, 2))
-    return True
