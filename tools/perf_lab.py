@@ -130,7 +130,9 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    import sys
+    if "-g" not in sys.argv and "-H" not in sys.argv:
+        main()
 
 
 def blockdiag_variants():
@@ -203,3 +205,60 @@ if __name__ == "__main__":
     import sys
     if "-g" in sys.argv:
         blockdiag_variants()
+
+
+def variant_matrix():
+    """H: the full traversal-variant x precision matrix on the live chip.
+
+    Run first when the TPU returns: measures the chunked XLA fast path,
+    the per-chunk Pallas kernels, and the whole-traversal kernel, each
+    at HIGH and HIGHEST child-contraction precision, against the scan
+    path baseline.  One line per cell, same Gup/s accounting as bench.py.
+    """
+    from examl_tpu.ops import pallas_whole
+
+    inst = default_instance(f"{DATA}/140", f"{DATA}/140.model")
+    tree = inst.tree_from_newick(open(f"{DATA}/140.tree").read())
+    eng = inst.engines[20]
+    _, entries = tree.full_traversal_centroid()
+    patterns = sum(p.width for p in inst.alignment.partitions)
+    E, R, K = len(entries), eng.R, eng.K
+    rep = functools.partial(report, entries=E, patterns=patterns,
+                            rates=R, states=K)
+    fsched = eng._fast_schedule(entries)
+    wsched = pallas_whole.build_flat(entries, eng.ntips,
+                                     eng.num_branch_slots)
+
+    def chained(step):
+        @jax.jit
+        def fn(clv, scaler):
+            def body(_, cs):
+                return step(cs[0], cs[1])
+            c, s = jax.lax.fori_loop(0, N_STEPS, body, (clv, scaler))
+            return jnp.sum(s)
+        return fn
+
+    for prec, ptag in ((jax.lax.Precision.HIGHEST, "HIGHEST"),
+                       (jax.lax.Precision.HIGH, "HIGH")):
+        eng.fast_precision = prec
+        for name, use_pallas, whole in (("xla-chunks", False, False),
+                                        ("pallas-chunks", True, False),
+                                        ("pallas-whole", True, True)):
+            eng.use_pallas = use_pallas
+            if whole:
+                step = (lambda c, s:
+                        eng.run_whole_traced(c, s, wsched))
+            else:
+                step = (lambda c, s:
+                        eng.run_chunks_traced(c, s, fsched.chunks))
+            try:
+                f = chained(step)
+                rep(f"H {name} {ptag}", timed(f, eng.clv, eng.scaler))
+            except Exception as exc:            # noqa: BLE001
+                print(f"H {name} {ptag}: FAILED {exc}")
+
+
+if __name__ == "__main__":
+    import sys
+    if "-H" in sys.argv:
+        variant_matrix()
