@@ -90,6 +90,7 @@ def _ensure_live_backend() -> None:
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     env["EXAML_BENCH_NO_PROBE"] = "1"
+    env["EXAML_BENCH_FALLBACK"] = "1"
     # Accelerator plugins loaded via sitecustomize can hang their host
     # process at import even under JAX_PLATFORMS=cpu; strip the plugin's
     # site dir from the child's path.  Path components to strip are
@@ -255,6 +256,9 @@ def main() -> None:
         "spr_scan_candidates": ncand,
         "baseline_source": base_src,
         "backend": jax.default_backend(),
+        **({"note": "accelerator unreachable after probe+retry; "
+                    "CPU fallback"}
+           if os.environ.get("EXAML_BENCH_FALLBACK") else {}),
     }))
 
 
