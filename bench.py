@@ -66,10 +66,10 @@ def _ensure_live_backend() -> None:
     if os.environ.get("EXAML_BENCH_NO_PROBE"):
         return
     ok = False
-    # Two tries: a flaky tunnel can heal between them.  The first keeps
-    # the original 240s budget so a slow-but-healthy cold init is never
-    # misclassified; the retry is shorter.
-    for attempt, budget in enumerate((240, 120)):
+    # Two tries: a flaky tunnel can heal between them.  Worst-case dead
+    # path (180 + 15 + 60 = 255s) stays under the single-probe budget the
+    # r02 driver window absorbed; a healthy init answers in seconds.
+    for attempt, budget in enumerate((180, 60)):
         try:
             proc = subprocess.run(
                 [sys.executable, "-c",
@@ -82,7 +82,7 @@ def _ensure_live_backend() -> None:
         if ok:
             break
         if attempt == 0:            # no dead wait after the final try
-            time.sleep(30)
+            time.sleep(15)
     if ok:
         return
     sys.stderr.write("bench: default backend unusable; falling back to "
