@@ -22,9 +22,27 @@ import os
 import sys
 import time
 
-_PROCESS_T0 = time.perf_counter()
+# Budget epoch shared across re-exec/fallback children: a child inherits
+# the ORIGINAL process's start time via EXAML_BENCH_T0 so probe time
+# already spent counts against the wall budget (the budget protects the
+# driver's bench window, not any single process).
+try:
+    _EPOCH0 = float(os.environ.get("EXAML_BENCH_T0") or time.time())
+except ValueError:
+    _EPOCH0 = time.time()
 
 import numpy as np
+
+
+def _elapsed() -> float:
+    return time.time() - _EPOCH0
+
+
+def _budget() -> float:
+    try:
+        return float(os.environ.get("EXAML_BENCH_BUDGET_S", "480"))
+    except ValueError:
+        return 480.0
 
 REPO = os.path.dirname(os.path.abspath(__file__))
 DATA = "/root/reference/testData"
@@ -54,42 +72,37 @@ def _load_instance():
     return inst, inst.random_tree(0), "synthetic-140"
 
 
-def _ensure_live_backend() -> None:
-    """Probe the default JAX backend in a SUBPROCESS; if it hangs or dies
-    (e.g. a wedged TPU tunnel or a libtpu version mismatch), re-exec on
-    CPU so the benchmark always records a result.  The probe must be a
-    child process: a broken accelerator plugin can hang its host process
-    inside client init, where no in-process timeout can recover."""
+def _probe_backend(budgets=(180, 60)) -> bool:
+    """Probe the default JAX backend in a SUBPROCESS; a broken
+    accelerator plugin can hang its host process inside client init,
+    where no in-process timeout can recover.  Multiple tries: a flaky
+    tunnel can heal between them."""
     import subprocess
     import sys
 
-    if os.environ.get("EXAML_BENCH_NO_PROBE"):
-        return
-    ok = False
-    # Two tries: a flaky tunnel can heal between them.  Worst-case dead
-    # path (180 + 15 + 60 = 255s) stays under the single-probe budget the
-    # r02 driver window absorbed; a healthy init answers in seconds.
-    for attempt, budget in enumerate((180, 60)):
+    for attempt, budget in enumerate(budgets):
         try:
             proc = subprocess.run(
                 [sys.executable, "-c",
                  "import jax; jax.devices(); "
                  "import jax.numpy as jnp; jnp.zeros(2).block_until_ready()"],
                 env=os.environ, capture_output=True, timeout=budget)
-            ok = proc.returncode == 0
+            if proc.returncode == 0:
+                return True
         except subprocess.TimeoutExpired:
-            ok = False
-        if ok:
-            break
-        if attempt == 0:            # no dead wait after the final try
+            pass
+        if attempt + 1 < len(budgets):   # no dead wait after the final try
             time.sleep(15)
-    if ok:
-        return
-    sys.stderr.write("bench: default backend unusable; falling back to "
-                     "CPU\n")
+    return False
+
+
+def _child_env(cpu: bool) -> dict:
     env = dict(os.environ)
-    env["JAX_PLATFORMS"] = "cpu"
     env["EXAML_BENCH_NO_PROBE"] = "1"
+    env["EXAML_BENCH_T0"] = repr(_EPOCH0)
+    if not cpu:
+        return env
+    env["JAX_PLATFORMS"] = "cpu"
     env["EXAML_BENCH_FALLBACK"] = "1"
     # Accelerator plugins loaded via sitecustomize can hang their host
     # process at import even under JAX_PLATFORMS=cpu; strip the plugin's
@@ -100,8 +113,72 @@ def _ensure_live_backend() -> None:
     pp = [p for p in env.get("PYTHONPATH", "").split(os.pathsep)
           if p and not any(c in p.split(os.sep) for c in strip if c)]
     env["PYTHONPATH"] = os.pathsep.join(pp) if pp else ""
-    os.execve(sys.executable, [sys.executable, os.path.abspath(__file__)],
-              env)
+    return env
+
+
+def _spawn_bench(cpu: bool, timeout: float):
+    """Run this benchmark in a child process; return its JSON line (str)
+    or None.  The child inherits the budget epoch so it skips secondary
+    metrics rather than blowing the driver's window."""
+    import subprocess
+    import sys
+
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)],
+            env=_child_env(cpu), capture_output=True, text=True,
+            timeout=max(60.0, timeout))
+    except subprocess.TimeoutExpired as e:
+        if e.stderr:
+            sys.stderr.write(e.stderr if isinstance(e.stderr, str)
+                             else e.stderr.decode(errors="replace"))
+        return None
+    sys.stderr.write(proc.stderr)
+    for line in reversed(proc.stdout.splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                json.loads(line)
+                return line
+            except ValueError:
+                continue
+    return None
+
+
+def _ensure_live_backend() -> None:
+    """Probe the default backend; on failure record a CPU fallback run in
+    a child, then RE-PROBE late in the wall budget (a flaky tunnel often
+    heals within minutes — round-3 lesson) and, if the chip answers,
+    supersede the CPU line with a real accelerator run."""
+    import sys
+
+    if os.environ.get("EXAML_BENCH_NO_PROBE"):
+        return
+    if _probe_backend():
+        return
+    sys.stderr.write("bench: default backend unusable; falling back to "
+                     "CPU (will re-probe late in the budget)\n")
+    budget = _budget()
+    # Generous floor: the old execve path had NO timeout and its "always
+    # records a result" guarantee must survive — the child's own budget
+    # clock (inherited epoch) handles skipping secondary metrics; the
+    # hard kill exists only for a pathological hang.
+    cpu_line = _spawn_bench(cpu=True,
+                            timeout=max(900.0, budget - _elapsed() + 180))
+    # Late retry window: everything left of the budget (plus grace) goes
+    # to one more probe + a full accelerator run if the tunnel healed.
+    if budget - _elapsed() > 90 and _probe_backend(budgets=(60,)):
+        sys.stderr.write("bench: accelerator healed on late re-probe; "
+                         "re-running on default backend\n")
+        tpu_line = _spawn_bench(cpu=False,
+                                timeout=budget - _elapsed() + 240)
+        if tpu_line is not None:
+            print(tpu_line)
+            raise SystemExit(0)
+    if cpu_line is not None:
+        print(cpu_line)
+        raise SystemExit(0)
+    raise SystemExit("bench: no variant produced a result")
 
 
 def main() -> None:
@@ -153,14 +230,10 @@ def main() -> None:
     # window is finite), so later variants are skipped once a number is
     # in hand and the budget is spent.  The clock includes everything
     # since process start (probe, instance build, first evaluate).
-    try:
-        budget = float(os.environ.get("EXAML_BENCH_BUDGET_S", "480"))
-    except ValueError:
-        budget = 480.0
-    bench_t0 = _PROCESS_T0
+    budget = _budget()
     dt, variant = None, None
     for name, step in variants:
-        if dt is not None and time.perf_counter() - bench_t0 > budget:
+        if dt is not None and _elapsed() > budget:
             sys.stderr.write(f"bench: budget spent; skipping {name}\n")
             continue
         try:
@@ -195,7 +268,7 @@ def main() -> None:
     # recorded.
     eval_ms = newton_ms = scan_ms = float("nan")
     ncand = 0
-    if time.perf_counter() - bench_t0 < budget:
+    if _elapsed() < budget:
         inner = [tree.nodep[n] for n in tree.inner_numbers()
                  if not tree.is_tip(tree.nodep[n].back.number)][:12]
         for p in inner:     # warm compile variants
@@ -210,7 +283,7 @@ def main() -> None:
             inst.makenewz(tree, p, p.back, p.z, maxiter=16)
         newton_ms = (time.perf_counter() - t0) / len(inner) * 1000
 
-    if time.perf_counter() - bench_t0 < budget:
+    if _elapsed() < budget:
         from examl_tpu.search import batchscan, spr
         from examl_tpu.tree.topology import hookup
         ctx = spr.SprContext(inst, thorough=False, do_cutoff=False)
@@ -240,11 +313,19 @@ def main() -> None:
         avx = FALLBACK_AVX_UPDATES_PER_SEC
         base_src = "estimate"
 
+    backend = jax.default_backend()
+    # A fallback run is NEVER comparable to an accelerator number: the
+    # baseline is one AVX socket and the metric races the chip against
+    # it, so vs_baseline only "counts" when the run executed on tpu/axon
+    # (round-3 lesson: BENCH_r03 recorded a CPU number that read like a
+    # regression).
+    vs_valid = backend in ("tpu", "axon")
     print(json.dumps({
         "metric": "site_clv_updates_per_sec",
         "value": round(ups, 1),
         "unit": "updates/s",
         "vs_baseline": round(ups / avx, 3),
+        "vs_baseline_valid": vs_valid,
         "dataset": dataset,
         "dtype": str(eng.dtype),
         "lnl": round(float(lnl), 6),
@@ -255,7 +336,7 @@ def main() -> None:
         "spr_scan_ms_per_node": round(scan_ms, 3),
         "spr_scan_candidates": ncand,
         "baseline_source": base_src,
-        "backend": jax.default_backend(),
+        "backend": backend,
         **({"note": "accelerator unreachable after probe+retry; "
                     "CPU fallback"}
            if os.environ.get("EXAML_BENCH_FALLBACK") else {}),

@@ -149,6 +149,7 @@ class LikelihoodEngine:
         self._want_pallas = self._pallas_env != "0"
         self.use_pallas = False        # decided once tensors are placed
         self.pallas_whole = False
+        self._pallas_proven = False    # a Pallas program completed here
 
         lane = bucket.lane
         B = bucket.num_blocks
@@ -314,22 +315,43 @@ class LikelihoodEngine:
         self.site_rates = jnp.asarray(
             rates.reshape(self.B, self.lane, 1), dtype=self.dtype)
 
+    def _pallas_failed(self, exc: Exception) -> None:
+        """Permanently demote this engine to the validated XLA fast path
+        after a Mosaic compile/lowering failure (the Pallas tiers were
+        developed against interpret mode; real-hardware lowering bugs
+        must degrade, not abort the search).  Only UNPROVEN kernels are
+        demoted — once a Pallas program has completed on this engine, a
+        later failure is a transient device error (OOM, tunnel hiccup)
+        that must propagate, not silently cost the rest of a multi-hour
+        search its fast path (the caller re-raises in that case).
+        Donated buffers survive a compile-time failure (donation happens
+        at execution), which is the failure class Mosaic produces; a
+        post-donation runtime fault leaves the arena deleted and the
+        retry will surface it."""
+        import warnings
+        warnings.warn(
+            "EXAML: Pallas kernel dispatch failed (%s: %s); permanently "
+            "falling back to the XLA fast path for this engine. Set "
+            "EXAML_PALLAS=0 to silence." % (type(exc).__name__, exc),
+            RuntimeWarning, stacklevel=3)
+        self.use_pallas = False
+        self.pallas_whole = False
+        self._fast_jit_cache.clear()
+
     def run_traversal(self, entries: List[TraversalEntry],
                       full: bool = False) -> None:
         if not entries:
             return
         if full and self._fast_eligible(entries):
-            if self.pallas_whole:
-                self._run_whole(entries)
-                return
-            sched = self._fast_schedule(entries)
-            fn = self._fast_fn(sched.profile, with_eval=False)
-            data = tuple((c.base, c.lidx, c.ridx, c.lcode, c.rcode,
-                          c.zl, c.zr) for c in sched.chunks)
-            self.clv, self.scaler = fn(self.clv, self.scaler, data,
-                                       self.models, self.block_part,
-                                       self.tips)
-            self._install_row_map(sched)
+            try:
+                self._run_fast_traversal(entries)
+                self._pallas_proven = self.use_pallas
+            except Exception as exc:           # Mosaic lowering/compile
+                if not self.use_pallas or getattr(self, "_pallas_proven",
+                                                  False):
+                    raise
+                self._pallas_failed(exc)
+                self._run_fast_traversal(entries)
             return
         if self.save_memory:
             self._sev_begin(entries)
@@ -339,6 +361,19 @@ class LikelihoodEngine:
             buf, self.scaler, aux, tv, self.models, self.block_part,
             self.tips, self.site_rates)
         self._set_buf(buf)
+
+    def _run_fast_traversal(self, entries: List[TraversalEntry]) -> None:
+        if self.pallas_whole:
+            self._run_whole(entries)
+            return
+        sched = self._fast_schedule(entries)
+        fn = self._fast_fn(sched.profile, with_eval=False)
+        data = tuple((c.base, c.lidx, c.ridx, c.lcode, c.rcode,
+                      c.zl, c.zr) for c in sched.chunks)
+        self.clv, self.scaler = fn(self.clv, self.scaler, data,
+                                   self.models, self.block_part,
+                                   self.tips)
+        self._install_row_map(sched)
 
     # -- engine state: dense CLV buffer or SEV pool -------------------------
     # Every device program takes (buf, scaler, aux): dense aux = (),
@@ -467,15 +502,6 @@ class LikelihoodEngine:
 
     def _run_whole(self, entries, p_num=None, q_num=None, z=None):
         sched, args = self._whole_args(entries)
-
-        def gidx_new(num: int) -> int:
-            # against the NEW layout, WITHOUT installing it yet: a Mosaic
-            # failure below must not leave the row map pointing at rows
-            # the arena does not hold.
-            if num <= self.ntips:
-                return num - 1
-            return self.ntips + sched.row_of[num]
-
         if p_num is None:
             fn = self._whole_fn(sched.e_real, with_eval=False)
             self.clv, self.scaler = fn(self.clv, self.scaler, *args,
@@ -487,8 +513,9 @@ class LikelihoodEngine:
         zv = jnp.asarray(_z_slots(z, self.num_branch_slots),
                          dtype=self.dtype)
         self.clv, self.scaler, out = fn(
-            self.clv, self.scaler, *args, jnp.int32(gidx_new(p_num)),
-            jnp.int32(gidx_new(q_num)), zv, self.models,
+            self.clv, self.scaler, *args,
+            jnp.int32(self._gidx_of(sched, p_num)),
+            jnp.int32(self._gidx_of(sched, q_num)), zv, self.models,
             self.block_part, self.weights, self.tips)
         self._install_row_map(sched)
         return np.asarray(out)
@@ -694,20 +721,16 @@ class LikelihoodEngine:
                           q_num: int, z: Sequence[float],
                           full: bool = False) -> np.ndarray:
         if full and entries and self._fast_eligible(entries):
-            if self.pallas_whole:
-                return self._run_whole(entries, p_num, q_num, z)
-            sched = self._fast_schedule(entries)
-            fn = self._fast_fn(sched.profile, with_eval=True)
-            data = tuple((c.base, c.lidx, c.ridx, c.lcode, c.rcode,
-                          c.zl, c.zr) for c in sched.chunks)
-            self._install_row_map(sched)
-            zv = jnp.asarray(_z_slots(z, self.num_branch_slots),
-                             dtype=self.dtype)
-            self.clv, self.scaler, out = fn(
-                self.clv, self.scaler, data, jnp.int32(self._gidx(p_num)),
-                jnp.int32(self._gidx(q_num)), zv, self.models,
-                self.block_part, self.weights, self.tips)
-            return np.asarray(out)
+            try:
+                out = self._trav_eval_fast(entries, p_num, q_num, z)
+                self._pallas_proven = self.use_pallas
+                return out
+            except Exception as exc:           # Mosaic lowering/compile
+                if not self.use_pallas or getattr(self, "_pallas_proven",
+                                                  False):
+                    raise
+                self._pallas_failed(exc)
+                return self._trav_eval_fast(entries, p_num, q_num, z)
         if self.save_memory:
             self._sev_begin(entries)
         tv = self._traversal_arrays(entries)
@@ -719,6 +742,34 @@ class LikelihoodEngine:
             self.weights, self.tips, self.site_rates)
         self._set_buf(buf)
         return np.asarray(out)
+
+    def _trav_eval_fast(self, entries, p_num, q_num, z) -> np.ndarray:
+        if self.pallas_whole:
+            return self._run_whole(entries, p_num, q_num, z)
+        sched = self._fast_schedule(entries)
+        fn = self._fast_fn(sched.profile, with_eval=True)
+        data = tuple((c.base, c.lidx, c.ridx, c.lcode, c.rcode,
+                      c.zl, c.zr) for c in sched.chunks)
+
+        zv = jnp.asarray(_z_slots(z, self.num_branch_slots),
+                         dtype=self.dtype)
+        self.clv, self.scaler, out = fn(
+            self.clv, self.scaler, data,
+            jnp.int32(self._gidx_of(sched, p_num)),
+            jnp.int32(self._gidx_of(sched, q_num)), zv, self.models,
+            self.block_part, self.weights, self.tips)
+        self._install_row_map(sched)
+        return np.asarray(out)
+
+    def _gidx_of(self, sched, num: int) -> int:
+        """gather_child index of a node against a schedule's NEW layout
+        WITHOUT installing it: a kernel failure between schedule build
+        and dispatch must not leave self.row_map pointing at rows the
+        arena does not hold (shared by the chunk and whole-traversal
+        fast paths)."""
+        if num <= self.ntips:
+            return num - 1
+        return self.ntips + sched.row_of[num]
 
     def _newton_impl(self, buf, scaler, aux, tv, p_idx, q_idx, z0,
                      maxiters, conv, dm, block_part, weights, tips, sr):

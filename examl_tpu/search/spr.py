@@ -382,10 +382,43 @@ def dfs_slot_order(tree: Tree) -> List[Node]:
 def batched_scan_enabled(inst: PhyloInstance) -> bool:
     """True when the lazy arm uses the one-dispatch-per-pruned-node scan
     (search/batchscan.py), GAMMA or PSR; -S engines keep the sequential
-    primitives (pools have no scan region), EXAML_BATCH_SCAN=0 forces
-    them everywhere."""
+    primitives (pools have no scan region).
+
+    Like the thorough arm, the lazy scan trades compute (the whole
+    radius window, no mid-descent lnL-cutoff early-outs) for dispatch
+    count, which wins where dispatch latency dominates (accelerator
+    tunnel) and loses on host CPU where the sequential cutoff arm's
+    skipped work is the cheaper currency -- so by default it is gated
+    to accelerator devices.  EXAML_BATCH_SCAN=0 forces sequential
+    everywhere; =1 forces the batched scan on any backend (the -S
+    structural restriction still holds)."""
     import os
-    if os.environ.get("EXAML_BATCH_SCAN", "1") == "0":
+    if not _scan_structurally_ok(inst):
+        return False
+    if os.environ.get("EXAML_BATCH_SCAN") == "1":
+        return True
+    return _on_accelerator(inst)
+
+
+def _on_accelerator(inst: PhyloInstance) -> bool:
+    """True when every engine's CLV arena lives on an accelerator device
+    (the placement decision, not the default backend — a
+    jax.default_device(cpu) fallback leaves default_backend()=='tpu')."""
+    for e in inst.engines.values():
+        if e.clv is None:
+            return False
+        platform = next(iter(e.clv.devices())).platform
+        if platform not in ("tpu", "axon"):
+            return False
+    return True
+
+
+def _scan_structurally_ok(inst: PhyloInstance) -> bool:
+    """Hard constraints of the scan region, shared by both batched arms:
+    -S pools have no scan region; EXAML_BATCH_SCAN=0 forces sequential
+    primitives everywhere."""
+    import os
+    if os.environ.get("EXAML_BATCH_SCAN") == "0":
         return False
     return not any(getattr(e, "save_memory", False)
                    for e in inst.engines.values())
@@ -497,17 +530,13 @@ def thorough_batched_ok(inst: PhyloInstance) -> bool:
     forced = os.environ.get("EXAML_BATCH_THOROUGH")
     if forced == "0":
         return False
-    if not (batched_scan_enabled(inst) and len(inst.engines) == 1
+    if not (_scan_structurally_ok(inst) and len(inst.engines) == 1
             and inst.num_branch_slots == 1
             and not getattr(inst, "psr", False)):
         return False
     if forced == "1":
         return True
-    (eng,) = inst.engines.values()
-    if eng.clv is None:
-        return False
-    platform = next(iter(eng.clv.devices())).platform
-    return platform in ("tpu", "axon")
+    return _on_accelerator(inst)
 
 
 def rearrange_batched_thorough(inst: PhyloInstance, tree: Tree,

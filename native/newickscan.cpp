@@ -19,6 +19,7 @@
 #define PY_SSIZE_T_CLEAN
 #include <Python.h>
 
+#include <cctype>
 #include <charconv>
 #include <cmath>
 #include <cstdint>
@@ -127,7 +128,12 @@ bool scan_newick(const char *s, size_t n, Scan &out) {
        * LLVM 20); older C++17 toolchains fall back to strtod and keep
        * the (pre-existing) locale caveat rather than failing the
        * build. */
-      size_t j = i + (i < n && s[i] == '+' ? 1 : 0);
+      /* skip the '+' only when a digit or '.' follows: ':+-0.5' must
+       * stay a parse error (strtod, float() and the reference reject
+       * it), not parse as -0.5 */
+      size_t j = i + (i + 1 < n && s[i] == '+'
+                      && (std::isdigit((unsigned char)s[i + 1])
+                          || s[i + 1] == '.') ? 1 : 0);
       double len = 0.0;
 #if defined(__cpp_lib_to_chars) && __cpp_lib_to_chars >= 201611L
       auto res = std::from_chars(s + j, s + n, len);
