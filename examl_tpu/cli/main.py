@@ -179,12 +179,25 @@ def write_model_params(path: str, inst) -> None:
             f.write("\n")
 
 
-def _load_alignment(path: str):
-    from examl_tpu.io.bytefile import BYTEFILE_MAGIC, read_bytefile
+def _is_bytefile(path: str) -> bool:
+    from examl_tpu.io.bytefile import BYTEFILE_MAGIC
     import struct
     with open(path, "rb") as f:
         head = f.read(12)
-    if len(head) == 12 and struct.unpack("<iii", head)[2] == BYTEFILE_MAGIC:
+    return (len(head) == 12
+            and struct.unpack("<iii", head)[2] == BYTEFILE_MAGIC)
+
+
+def _load_alignment(path: str, local_window=None, block_multiple: int = 1):
+    """Full read, or — in a multi-process job — only this process's site
+    columns (reference per-rank loading, `byteFile.c:278-382`)."""
+    if _is_bytefile(path):
+        if local_window is not None:
+            from examl_tpu.io.bytefile import read_bytefile_for_process
+            procid, nprocs = local_window
+            return read_bytefile_for_process(path, procid, nprocs,
+                                             block_multiple=block_multiple)
+        from examl_tpu.io.bytefile import read_bytefile
         return read_bytefile(path)
     from examl_tpu.io.alignment import load_alignment
     return load_alignment(path)             # convenience: raw PHYLIP, DNA
@@ -282,8 +295,9 @@ def run_search(args, inst, files: RunFiles) -> int:
 
     files.info(f"Likelihood of best tree: {res.likelihood:.6f}")
     files.write_result(tree.to_newick(inst.alignment.taxon_names))
-    _write_per_gene_trees(args, inst, tree, files)
-    write_model_params(files.model_path, inst)
+    if files.primary:       # processID==0 gating (axml.c, every output)
+        _write_per_gene_trees(args, inst, tree, files)
+        write_model_params(files.model_path, inst)
     if res.good_trees:
         good = os.path.join(args.workdir,
                             f"ExaML_goodTrees.{args.run_id}")
@@ -392,9 +406,10 @@ def run_tree_evaluation(args, inst, files: RunFiles) -> int:
     best = max(range(len(lnls)), key=lambda i: lnls[i])
     files.info(f"Evaluated {len(lnls)} trees; best is tree {best} "
                f"with likelihood {lnls[best]:.6f}")
-    with open(files.treefile_path, "w") as f:
-        f.write("\n".join(results) + "\n")
-    write_model_params(files.model_path, inst)
+    if files.primary:       # processID==0 gating (axml.c, every output)
+        with open(files.treefile_path, "w") as f:
+            f.write("\n".join(results) + "\n")
+        write_model_params(files.model_path, inst)
     return 0
 
 
@@ -441,16 +456,37 @@ def main(argv=None) -> int:
 
     with files.phase("startup (io + engines)"):
         sharding = select_sharding(args, args.save_memory, log=files.info)
-        data = _load_alignment(args.bytefile)
-        files.info(f"{data.ntaxa} taxa, {data.total_patterns} patterns, "
-                   f"{len(data.partitions)} partitions")
+        # Multi-process jobs read only their own site columns (the
+        # reference's readMyData) — unless the model needs host-global
+        # per-site state (PSR) or the input is not a byteFile.
+        local_window = None
+        if sharding is not None and _is_bytefile(args.bytefile):
+            import jax
+            if jax.process_count() > 1:
+                if args.model == "PSR":
+                    files.info("PSR keeps whole-file reads per process "
+                               "(host-global per-site rate state)")
+                else:
+                    local_window = (jax.process_index(),
+                                    jax.process_count())
+                    files.info(
+                        f"selective byteFile read: process "
+                        f"{local_window[0]} of {local_window[1]} loads "
+                        f"only its site blocks")
+        data = _load_alignment(
+            args.bytefile, local_window=local_window,
+            block_multiple=(sharding.num_devices if sharding else 1))
+        files.info(f"{data.ntaxa} taxa, {data.total_patterns} patterns"
+                   + (" (this process)" if local_window else "")
+                   + f", {len(data.partitions)} partitions")
 
         inst = PhyloInstance(
             data, ncat=4, use_median=args.median,
             per_partition_branches=args.per_partition_bl,
             rate_model=args.model, psr_categories=args.categories,
             save_memory=args.save_memory, sharding=sharding,
-            block_multiple=(sharding.num_devices if sharding else 1))
+            block_multiple=(sharding.num_devices if sharding else 1),
+            local_window=local_window)
         inst.auto_prot_criterion = args.auto_prot
         _packing_report(inst, files)
 

@@ -28,7 +28,8 @@ class PhyloInstance:
                  per_partition_branches: bool = False,
                  block_multiple: int = 1, sharding=None,
                  rate_model: str = "GAMMA", psr_categories: int = 25,
-                 save_memory: bool = False):
+                 save_memory: bool = False,
+                 local_window: Optional[tuple] = None):
         from examl_tpu.config import default_dtype
         if rate_model not in ("GAMMA", "PSR"):
             raise ValueError(f"unknown rate model {rate_model!r}")
@@ -83,8 +84,25 @@ class PhyloInstance:
                 part.datatype, freqs, rates=rates, alpha=1.0, ncat=ncat,
                 use_median=use_median))
 
-        self.buckets = pack_partitions(alignment.partitions,
-                                       block_multiple=block_multiple)
+        if local_window is not None:
+            # Multi-host selective loading: `alignment` holds only this
+            # process's site columns (io/bytefile.read_bytefile_for_process)
+            # and the buckets are the matching local window of the global
+            # packed axis (reference per-rank loading, byteFile.c:278-382).
+            from examl_tpu.parallel.packing import pack_partitions_local
+            procid, nprocs = local_window
+            if self.psr:
+                raise ValueError("per-process selective loading does not "
+                                 "support PSR yet")
+            if save_memory:
+                raise ValueError("-S (SEV) does not compose with "
+                                 "per-process selective loading")
+            self.buckets = pack_partitions_local(
+                alignment.partitions, procid, nprocs,
+                block_multiple=block_multiple)
+        else:
+            self.buckets = pack_partitions(alignment.partitions,
+                                           block_multiple=block_multiple)
         self.engines: Dict[int, LikelihoodEngine] = {}
         for states, bucket in self.buckets.items():
             branch_indices = ([bucket.part_ids[i] for i in range(bucket.num_parts)]

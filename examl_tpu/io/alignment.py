@@ -34,6 +34,11 @@ class PartitionData:
     lg4: bool = False
     auto: bool = False
     branch_index: int = 0
+    # Set by selective byteFile reads (io/bytefile.py): the partition's
+    # FULL pattern count and this slice's starting column within it.
+    # None/0 means `patterns` holds the whole partition.
+    global_width: int | None = None
+    global_col_offset: int = 0
 
     @property
     def width(self) -> int:

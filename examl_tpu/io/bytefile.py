@@ -28,6 +28,7 @@ Z=21, X/-=22; BIN: 1, 2, 3).
 from __future__ import annotations
 
 import struct
+from dataclasses import dataclass
 from typing import List, Sequence
 
 import numpy as np
@@ -115,8 +116,43 @@ def write_bytefile(path: str, data: AlignmentData) -> None:
             f.write(np.ascontiguousarray(p.patterns, dtype=np.uint8).tobytes())
 
 
-def read_bytefile(path: str) -> AlignmentData:
-    """Read a byteFile (ours or the reference parser's) into AlignmentData."""
+@dataclass
+class BytePartMeta:
+    """Per-partition byteFile metadata plus the byte offset of its
+    pattern data section (partition-major, taxon-major within)."""
+    states: int
+    lower: int                  # global pattern range [lower, upper)
+    upper: int
+    dtype_i: int
+    prot: int
+    prot_freqs: bool
+    opt_freqs: bool
+    name: str
+    freqs: np.ndarray
+    data_offset: int
+
+    @property
+    def width(self) -> int:
+        return self.upper - self.lower
+
+
+@dataclass
+class ByteFileMeta:
+    """Everything in a byteFile EXCEPT weights and pattern data — the
+    seek map for selective per-process reads (reference `seekPos`,
+    `byteFile.c:31-83`)."""
+    path: str
+    ntaxa: int
+    num_pattern: int
+    taxon_names: List[str]
+    parts: List[BytePartMeta]
+    weights_offset: int
+
+
+def read_bytefile_meta(path: str) -> ByteFileMeta:
+    """Parse header + taxon names + partition metadata; SEEK past the
+    weights and pattern sections so host memory and IO stay O(metadata)
+    regardless of alignment size."""
     with open(path, "rb") as f:
         szt, version, magic = _r(f, "iii")
         if magic != BYTEFILE_MAGIC:
@@ -130,43 +166,151 @@ def read_bytefile(path: str) -> AlignmentData:
         (num_pattern,) = _r(f, "Q")
         (num_parts,) = _r(f, "i")
         _r(f, "d")                                    # gappyness (stats only)
-        wbytes = f.read(4 * num_pattern)
-        if len(wbytes) != 4 * num_pattern:
-            raise ValueError("truncated byteFile")
-        weights = np.frombuffer(wbytes, dtype="<i4")
+        weights_offset = f.tell()
+        f.seek(4 * num_pattern, 1)
         names = [_read_string(f) for _ in range(ntaxa)]
-        metas = []
+        parts: List[BytePartMeta] = []
         for _ in range(num_parts):
             states, _max_tip = _r(f, "ii")
             lower, upper, _width = _r(f, "QQQ")
             dtype_i, prot, prot_freqs, _non_gtr, opt_freqs = _r(f, "iiiii")
             pname = _read_string(f)
             freqs = np.frombuffer(f.read(8 * states), dtype="<f8")
-            metas.append((states, lower, upper, dtype_i, prot,
-                          bool(prot_freqs), bool(opt_freqs), pname, freqs))
+            parts.append(BytePartMeta(
+                states=states, lower=int(lower), upper=int(upper),
+                dtype_i=dtype_i, prot=prot, prot_freqs=bool(prot_freqs),
+                opt_freqs=bool(opt_freqs), name=pname, freqs=freqs,
+                data_offset=0))
+        off = f.tell()
+        for pm in parts:
+            pm.data_offset = off
+            off += ntaxa * pm.width
+    return ByteFileMeta(path=path, ntaxa=ntaxa, num_pattern=int(num_pattern),
+                        taxon_names=names, parts=parts,
+                        weights_offset=weights_offset)
+
+
+def _read_columns(f, meta: ByteFileMeta, pm: BytePartMeta, lo: int,
+                  hi: int) -> np.ndarray:
+    """[ntaxa, hi-lo] codes of partition columns [lo, hi) via one seek
+    per taxon row (reference `readMyData`, `byteFile.c:278-382`)."""
+    w = pm.width
+    n = hi - lo
+    out = np.empty((meta.ntaxa, n), dtype=np.uint8)
+    for t in range(meta.ntaxa):
+        f.seek(pm.data_offset + t * w + lo)
+        row = f.read(n)
+        if len(row) != n:
+            raise ValueError("truncated byteFile")
+        out[t] = np.frombuffer(row, dtype=np.uint8)
+    return out
+
+
+def _part_from_meta(pm: BytePartMeta, patterns: np.ndarray,
+                    weights: np.ndarray,
+                    col_offset: int = 0) -> PartitionData:
+    dt = datatypes.get(DATATYPE_NAME[pm.dtype_i])
+    if dt.name == "AA":
+        model_name = PROT_MODELS[pm.prot]
+    elif dt.name == "DNA":
+        model_name = "DNA"
+    else:
+        model_name = "BIN"
+    emp = np.asarray(pm.freqs, dtype=np.float64)
+    if not np.isfinite(emp).all() or emp.sum() <= 0:
+        if patterns.shape[1] != pm.width:
+            # A sliced read MUST NOT salvage from its own columns: each
+            # process would derive different frequencies from the same
+            # file and the replicated model arrays would silently
+            # diverge across the job.
+            raise ValueError(
+                f"partition {pm.name!r}: byteFile stores no usable "
+                f"frequencies and this is a per-process sliced read; "
+                f"re-run the parser or use a whole-file read")
+        emp = empirical_frequencies(patterns, weights, dt)
+    return PartitionData(
+        name=pm.name, datatype=dt, model_name=model_name,
+        patterns=np.ascontiguousarray(patterns),
+        weights=weights.astype(np.int64),
+        empirical_freqs=emp,
+        use_empirical_freqs=pm.prot_freqs or dt.name != "AA",
+        optimize_freqs=pm.opt_freqs,
+        lg4=model_name in ("LG4M", "LG4X"), auto=model_name == "AUTO",
+        global_width=pm.width if patterns.shape[1] != pm.width else None,
+        global_col_offset=col_offset)
+
+
+def read_bytefile(path: str) -> AlignmentData:
+    """Read a byteFile (ours or the reference parser's) into AlignmentData."""
+    meta = read_bytefile_meta(path)
+    with open(path, "rb") as f:
+        f.seek(meta.weights_offset)
+        wbytes = f.read(4 * meta.num_pattern)
+        if len(wbytes) != 4 * meta.num_pattern:
+            raise ValueError("truncated byteFile")
+        weights = np.frombuffer(wbytes, dtype="<i4")
         parts: List[PartitionData] = []
-        for (states, lower, upper, dtype_i, prot, prot_freqs, opt_freqs,
-             pname, freqs) in metas:
-            dt = datatypes.get(DATATYPE_NAME[dtype_i])
-            width = upper - lower
-            raw = np.frombuffer(f.read(ntaxa * width), dtype=np.uint8)
-            patterns = raw.reshape(ntaxa, width)
-            w = weights[lower:upper].astype(np.int64)
-            if dt.name == "AA":
-                model_name = PROT_MODELS[prot]
-            elif dt.name == "DNA":
-                model_name = "DNA"
-            else:
-                model_name = "BIN"
-            auto = model_name == "AUTO"
-            lg4 = model_name in ("LG4M", "LG4X")
-            emp = np.asarray(freqs, dtype=np.float64)
-            if not np.isfinite(emp).all() or emp.sum() <= 0:
-                emp = empirical_frequencies(patterns, w, dt)
-            parts.append(PartitionData(
-                name=pname, datatype=dt, model_name=model_name,
-                patterns=np.ascontiguousarray(patterns), weights=w,
-                empirical_freqs=emp,
-                use_empirical_freqs=prot_freqs or dt.name != "AA",
-                optimize_freqs=opt_freqs, lg4=lg4, auto=auto))
-    return AlignmentData(names, parts)
+        for pm in meta.parts:
+            f.seek(pm.data_offset)
+            raw = np.frombuffer(f.read(meta.ntaxa * pm.width),
+                                dtype=np.uint8)
+            if raw.size != meta.ntaxa * pm.width:
+                raise ValueError("truncated byteFile")
+            parts.append(_part_from_meta(
+                pm, raw.reshape(meta.ntaxa, pm.width),
+                weights[pm.lower:pm.upper].astype(np.int64)))
+    return AlignmentData(meta.taxon_names, parts)
+
+
+def read_bytefile_slice(path: str,
+                        columns: dict[int, tuple[int, int]]) -> AlignmentData:
+    """Read only the given per-partition column windows.
+
+    `columns` maps partition index -> (col_lo, col_hi) relative to the
+    partition; partitions absent from the map come back with width 0
+    (metadata — models, frequencies, names — is always global).  Host
+    memory and IO are proportional to the WINDOW, not the alignment:
+    this is the TPU-native `readMyData` (`byteFile.c:278-382`), where
+    each MPI rank seeks and reads only its assigned site blocks."""
+    meta = read_bytefile_meta(path)
+    with open(path, "rb") as f:
+        parts: List[PartitionData] = []
+        for gid, pm in enumerate(meta.parts):
+            lo, hi = columns.get(gid, (0, 0))
+            if not (0 <= lo <= hi <= pm.width):
+                raise ValueError(
+                    f"partition {gid}: window [{lo},{hi}) outside "
+                    f"[0,{pm.width})")
+            patterns = _read_columns(f, meta, pm, lo, hi)
+            f.seek(meta.weights_offset + 4 * (pm.lower + lo))
+            wbytes = f.read(4 * (hi - lo))
+            weights = np.frombuffer(wbytes, dtype="<i4").astype(np.int64)
+            parts.append(_part_from_meta(pm, patterns, weights,
+                                         col_offset=lo))
+    return AlignmentData(meta.taxon_names, parts)
+
+
+def read_bytefile_for_process(path: str, procid: int, nprocs: int,
+                              block_multiple: int | None = None
+                              ) -> AlignmentData:
+    """Read only the site columns process `procid` of `nprocs` owns.
+
+    The packed-bucket layout (parallel/packing.py) is a pure function of
+    the header metadata, so the process's block range — and its pre-image
+    in per-partition pattern columns — is computed WITHOUT touching
+    pattern data; then only those columns are seek-read.  Peak host
+    memory scales ~1/nprocs of the alignment.  `block_multiple` must
+    match the packing used at instance build (defaults to nprocs)."""
+    from examl_tpu.parallel.packing import pack_layout
+
+    if not (0 <= procid < nprocs):
+        raise ValueError(f"procid {procid} outside [0, {nprocs})")
+    meta = read_bytefile_meta(path)
+    layouts = pack_layout(
+        [(gid, pm.states, pm.width) for gid, pm in enumerate(meta.parts)],
+        block_multiple=block_multiple or nprocs)
+    columns: dict[int, tuple[int, int]] = {}
+    for lay in layouts.values():
+        for gid, lo, hi in lay.process_columns(procid, nprocs):
+            columns[gid] = (lo, hi)
+    return read_bytefile_slice(path, columns)

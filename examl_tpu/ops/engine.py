@@ -152,10 +152,18 @@ class LikelihoodEngine:
         self._pallas_proven = False    # a Pallas program completed here
 
         lane = bucket.lane
-        B = bucket.num_blocks
+        B = bucket.num_blocks              # GLOBAL (jit program shapes)
         self.B, self.lane = B, lane
         self.R = models[0].ncat
         self.K = bucket.states
+        if bucket.is_local:
+            if sharding is None:
+                raise ValueError("a local (sliced) bucket requires a "
+                                 "site-axis sharding")
+            if psr:
+                raise ValueError("per-process selective loading does not "
+                                 "support PSR yet (per-site rate state is "
+                                 "host-global)")
 
         if branch_indices is None:
             branch_indices = [0] * self.num_parts
@@ -167,9 +175,12 @@ class LikelihoodEngine:
         self.site_rates = (jnp.ones((B, lane, 1), dtype=self.dtype)
                            if psr else None)
 
-        self.block_part = jnp.asarray(bucket.block_part)
-        self.weights = jnp.asarray(
-            bucket.weights.reshape(B, lane), dtype=self.dtype)
+        Bl = bucket.local_num_blocks
+        self.block_part = self._put_blocks(
+            bucket.block_part, lambda s: s.blocks)
+        self.weights = self._put_blocks(
+            np.asarray(bucket.weights.reshape(Bl, lane), dtype=self.dtype),
+            lambda s: s.sites)
 
         self.tips = self._build_tip_state()
         if save_memory:
@@ -180,11 +191,11 @@ class LikelihoodEngine:
                                 self.dtype)
         else:
             self.sev = None
-            self.clv = jnp.zeros((self.num_rows, B, lane, self.R, self.K),
-                                 dtype=self.dtype)
-        self.scaler = jnp.zeros((self.num_rows, B, lane), dtype=jnp.int32)
-        if sharding is not None:
-            self.apply_sharding(sharding)
+            self.clv = self._zeros_sharded(
+                (self.num_rows, B, lane, self.R, self.K), self.dtype,
+                lambda s: s.clv)
+        self.scaler = self._zeros_sharded((self.num_rows, B, lane),
+                                          jnp.int32, lambda s: s.scaler)
         # Fused Pallas chunk kernels, gated on where the CLV arena actually
         # LIVES (a jax.default_device(cpu) fallback leaves
         # jax.default_backend() == "tpu", and lowering Mosaic kernels onto
@@ -233,21 +244,51 @@ class LikelihoodEngine:
 
     def _build_tip_state(self) -> kernels.TipState:
         dt = self._datatype()
-        table = jnp.asarray(dt.tip_indicator_table(), dtype=self.dtype)
+        table = self._put_replicated(
+            np.asarray(dt.tip_indicator_table(), dtype=self.dtype))
         codes = self.bucket.tip_codes.astype(np.uint8).reshape(
-            self.ntips, self.B, self.lane)
-        return kernels.TipState(codes=jnp.asarray(codes), table=table)
+            self.ntips, self.bucket.local_num_blocks, self.lane)
+        return kernels.TipState(
+            codes=self._put_blocks(codes, lambda s: s.scaler), table=table)
 
-    def apply_sharding(self, sharding) -> None:
-        """Shard the block axis of the big per-site tensors."""
-        self.sharding = sharding
-        self.clv = jax.device_put(self.clv, sharding.clv)
-        self.scaler = jax.device_put(self.scaler, sharding.scaler)
-        self.tips = kernels.TipState(
-            codes=jax.device_put(self.tips.codes, sharding.scaler),
-            table=jax.device_put(self.tips.table, sharding.replicated))
-        self.weights = jax.device_put(self.weights, sharding.sites)
-        self.block_part = jax.device_put(self.block_part, sharding.blocks)
+    # -- tensor placement ---------------------------------------------------
+    # Single-device: plain jnp arrays.  Sharded, global bucket: device_put
+    # of full-width host arrays.  Sharded, LOCAL bucket (multi-host
+    # selective loading): this process holds only its contiguous window of
+    # the block axis, and the global array is assembled from per-process
+    # shards — host memory never sees the full width (the reference's
+    # per-rank site slices, `byteFile.c:278-382`).
+
+    def _put_blocks(self, host: np.ndarray, pick):
+        """Place a block-axis host array (full width, or the local window
+        of a local bucket) under the sharding member pick selects."""
+        if self.sharding is None:
+            return jnp.asarray(host)
+        sh = pick(self.sharding)
+        if self.bucket.is_local:
+            return jax.make_array_from_process_local_data(sh, host)
+        return jax.device_put(jnp.asarray(host), sh)
+
+    def _put_replicated(self, host: np.ndarray):
+        if self.sharding is None:
+            return jnp.asarray(host)
+        return jax.device_put(jnp.asarray(host), self.sharding.replicated)
+
+    def _zeros_sharded(self, shape, dtype, pick):
+        """A zero array born with its final sharding: no single-device
+        (or single-process) staging of the full-size buffer — the CLV
+        arena is the framework's dominant allocation."""
+        if self.sharding is None:
+            return jnp.zeros(shape, dtype=dtype)
+        npdtype = np.dtype(dtype)
+
+        def shard_zeros(idx):
+            shard_shape = tuple(
+                len(range(*sl.indices(dim))) for sl, dim in zip(idx, shape))
+            return np.zeros(shard_shape, dtype=npdtype)
+
+        return jax.make_array_from_callback(shape, pick(self.sharding),
+                                            shard_zeros)
 
     def set_models(self, models: Sequence[ModelParams]) -> None:
         self.models = stack_models(models, self._branch_indices, self.dtype,
@@ -347,8 +388,7 @@ class LikelihoodEngine:
                 self._run_fast_traversal(entries)
                 self._pallas_proven = self.use_pallas
             except Exception as exc:           # Mosaic lowering/compile
-                if not self.use_pallas or getattr(self, "_pallas_proven",
-                                                  False):
+                if not self.use_pallas or self._pallas_proven:
                     raise
                 self._pallas_failed(exc)
                 self._run_fast_traversal(entries)
@@ -726,8 +766,7 @@ class LikelihoodEngine:
                 self._pallas_proven = self.use_pallas
                 return out
             except Exception as exc:           # Mosaic lowering/compile
-                if not self.use_pallas or getattr(self, "_pallas_proven",
-                                                  False):
+                if not self.use_pallas or self._pallas_proven:
                     raise
                 self._pallas_failed(exc)
                 return self._trav_eval_fast(entries, p_num, q_num, z)

@@ -34,7 +34,8 @@ SITE_AXIS = "sites"
 class SiteSharding:
     """NamedShardings for each engine tensor layout, all over one mesh axis.
 
-    Attribute names match what `LikelihoodEngine.apply_sharding` consumes:
+    Attribute names match what the engine's placement helpers
+    (`LikelihoodEngine._put_blocks` / `_zeros_sharded`) consume:
       clv     [rows, B, lane, R, K]  — blocks on axis 1
       scaler  [rows, B, lane]        — blocks on axis 1
       sites   [B, lane]              — blocks on axis 0 (weights)

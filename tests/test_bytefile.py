@@ -47,6 +47,108 @@ def test_write_read_roundtrip_exact(tmp_path_factory, data49, tree49_text):
         i2.evaluate(t2, full=True), abs=1e-9)
 
 
+def test_meta_matches_full_read(tmp_path_factory, data49):
+    from examl_tpu.io.bytefile import read_bytefile_meta
+    path = str(tmp_path_factory.mktemp("bf") / "t49.binary")
+    write_bytefile(path, data49)
+    meta = read_bytefile_meta(path)
+    assert meta.ntaxa == data49.ntaxa
+    assert meta.taxon_names == data49.taxon_names
+    assert meta.num_pattern == data49.total_patterns
+    lower = 0
+    for pm, p in zip(meta.parts, data49.partitions):
+        assert (pm.lower, pm.upper) == (lower, lower + p.width)
+        assert pm.states == p.states
+        lower += p.width
+
+
+def test_sliced_read_reproduces_full_read(tmp_path_factory, data49):
+    """Per-process selective reads concatenate back to the full arrays
+    (reference `readMyData` equivalence, `byteFile.c:278-382`)."""
+    from examl_tpu.io.bytefile import read_bytefile_for_process
+    from examl_tpu.parallel.packing import pack_layout
+    path = str(tmp_path_factory.mktemp("bf") / "t49.binary")
+    write_bytefile(path, data49)
+    full = read_bytefile(path)
+    nprocs = 4
+    layouts = pack_layout(
+        [(g, p.states, p.width) for g, p in enumerate(full.partitions)],
+        block_multiple=nprocs)
+    got_cols = {g: [] for g in range(len(full.partitions))}
+    for proc in range(nprocs):
+        sl = read_bytefile_for_process(path, proc, nprocs)
+        assert sl.taxon_names == full.taxon_names
+        windows = {}
+        for lay in layouts.values():
+            for gid, lo, hi in lay.process_columns(proc, nprocs):
+                windows[gid] = (lo, hi)
+        for gid, (sp, fp) in enumerate(zip(sl.partitions, full.partitions)):
+            lo, hi = windows.get(gid, (0, 0))
+            assert sp.width == hi - lo
+            np.testing.assert_array_equal(sp.patterns,
+                                          fp.patterns[:, lo:hi])
+            np.testing.assert_array_equal(sp.weights, fp.weights[lo:hi])
+            got_cols[gid].append((lo, hi))
+    # The windows tile every partition: each column owned exactly once.
+    for gid, p in enumerate(full.partitions):
+        spans = sorted(w for w in got_cols[gid] if w[0] != w[1])
+        covered = 0
+        for lo, hi in spans:
+            assert lo == covered, (gid, spans)
+            covered = hi
+        assert covered == p.width, (gid, covered, p.width)
+
+
+@pytest.mark.slow
+def test_sliced_read_memory_scales(tmp_path_factory):
+    """Peak host RSS of a sliced read is a small fraction of the full
+    read's on a ~1M-pattern byteFile (the reference-scale regime where
+    whole-file reads per process stop being viable, byteFile.c:278-382)."""
+    import subprocess
+    import sys
+
+    from examl_tpu import datatypes
+    from examl_tpu.io.alignment import AlignmentData, PartitionData
+
+    ntaxa, width = 48, 1_000_000
+    rng = np.random.default_rng(7)
+    patterns = rng.integers(1, 16, size=(ntaxa, width), dtype=np.uint8)
+    part = PartitionData(
+        name="big", datatype=datatypes.get("DNA"), model_name="DNA",
+        patterns=patterns, weights=np.ones(width, dtype=np.int64),
+        empirical_freqs=np.full(4, 0.25), use_empirical_freqs=True,
+        optimize_freqs=False)
+    path = str(tmp_path_factory.mktemp("bigbf") / "big.binary")
+    write_bytefile(path, AlignmentData([f"t{i}" for i in range(ntaxa)],
+                                       [part]))
+    del patterns, part
+
+    def child_read_rss_delta(body: str) -> int:
+        """Bytes of RSS the read itself retains, measured in a fresh
+        process (package import baseline — jax — is subtracted by
+        sampling /proc/self/statm around the read)."""
+        code = ("import examl_tpu.io.bytefile as bf\n"
+                "def rss():\n"
+                "    import os\n"
+                "    with open('/proc/self/statm') as f:\n"
+                "        return int(f.read().split()[1]) * os.sysconf("
+                "'SC_PAGE_SIZE')\n"
+                "pre = rss()\n"
+                f"{body}\n"
+                "print(rss() - pre)")
+        out = subprocess.run([sys.executable, "-c", code],
+                             capture_output=True, text=True, check=True,
+                             env={**os.environ, "JAX_PLATFORMS": "cpu",
+                                  "PALLAS_AXON_POOL_IPS": ""})
+        return int(out.stdout.strip().splitlines()[-1])
+
+    full = child_read_rss_delta(f"d = bf.read_bytefile({path!r})")
+    sliced = child_read_rss_delta(
+        f"d = bf.read_bytefile_for_process({path!r}, 0, 8)")
+    assert full > 40_000_000, full                  # full read ~48MB+
+    assert sliced < full / 3, (full, sliced)
+
+
 def test_read_reference_parser_output(data49, tree49_text):
     """Our reader consumes the reference parser's binary; patterns and
     weights agree exactly, lnL agrees to the empirical-frequency rounding

@@ -1,0 +1,175 @@
+"""Multi-host execution: >= 2 OS processes via jax.distributed.
+
+The reference's entire identity is a multi-node MPI program
+(`axml.c:2573-2577`: MPI_Init, rank discovery; `communication.c:120-182`:
+per-rank reductions).  These tests launch REAL separate processes over a
+local coordinator — 2 processes x 4 virtual CPU devices — and assert the
+global SPMD program computes the single-process answer, with per-process
+selective data loading and process-0 output gating."""
+
+import os
+import re
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from tests.conftest import TESTDATA
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+pytestmark = pytest.mark.slow
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _mh_env(ndev: int) -> dict:
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PALLAS_AXON_POOL_IPS"] = ""
+    pp = [p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+          if p and ".axon_site" not in p.split(os.sep)]
+    env["PYTHONPATH"] = os.pathsep.join([REPO] + pp)
+    flags = " ".join(f for f in env.get("XLA_FLAGS", "").split()
+                     if "host_platform_device_count" not in f)
+    env["XLA_FLAGS"] = (
+        f"{flags} --xla_force_host_platform_device_count={ndev}").strip()
+    env.pop("JAX_PLATFORM_NAME", None)
+    env.pop("JAX_NUM_CPU_DEVICES", None)
+    return env
+
+
+def _launch(codes, ndev: int, timeout: int = 600):
+    """Run one python per code string concurrently; return stdouts."""
+    env = _mh_env(ndev)
+    procs = [subprocess.Popen([sys.executable, "-c", c], env=env, cwd=REPO,
+                              stdout=subprocess.PIPE,
+                              stderr=subprocess.PIPE, text=True)
+             for c in codes]
+    outs = []
+    for i, p in enumerate(procs):
+        try:
+            out, err = p.communicate(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        assert p.returncode == 0, f"proc {i} rc={p.returncode}:\n{err[-3000:]}"
+        outs.append(out)
+    return outs
+
+
+def test_multihost_dryrun_matches_single_process():
+    """2 processes x 4 devices == 1 process x 8 devices, same lnL."""
+    from __graft_entry__ import dryrun_multihost
+    dryrun_multihost(2, 4)      # asserts children agree internally
+
+
+CHILD = """
+import sys; sys.path.insert(0, {repo!r})
+import jax
+jax.distributed.initialize(coordinator_address="127.0.0.1:{port}",
+                           num_processes=2, process_id={procid})
+import numpy as np
+from examl_tpu.io.bytefile import read_bytefile_for_process
+from examl_tpu.instance import PhyloInstance
+from examl_tpu.parallel.sharding import default_site_sharding
+
+ndev = jax.device_count()
+sl = read_bytefile_for_process({bf!r}, {procid}, 2, block_multiple=ndev)
+print("local_patterns:", sum(p.width for p in sl.partitions))
+inst = PhyloInstance(sl, sharding=default_site_sharding(),
+                     block_multiple=ndev, local_window=({procid}, 2))
+tree = inst.tree_from_newick(open({tree!r}).read())
+print("lnL= %.6f" % float(inst.evaluate(tree, full=True)))
+"""
+
+
+def test_multihost_selective_load_matches_full_read(tmp_path):
+    """Each process reads ONLY its site columns (readMyData,
+    byteFile.c:278-382) yet the global program computes the full-read
+    lnL."""
+    from examl_tpu.instance import PhyloInstance
+    from examl_tpu.io.alignment import load_alignment
+    from examl_tpu.io.bytefile import write_bytefile
+
+    data = load_alignment(f"{TESTDATA}/49", f"{TESTDATA}/49.model")
+    bf = str(tmp_path / "t49.binary")
+    write_bytefile(bf, data)
+    # Single-process full-read reference value (float32 default dtype,
+    # like the children).
+    inst = PhyloInstance(data)
+    tree = inst.tree_from_newick(open(f"{TESTDATA}/49.tree").read())
+    ref = float(inst.evaluate(tree, full=True))
+
+    port = _free_port()
+    outs = _launch(
+        [CHILD.format(repo=REPO, port=port, procid=p, bf=bf,
+                      tree=f"{TESTDATA}/49.tree") for p in range(2)],
+        ndev=4)
+    lnls, widths = [], []
+    for out in outs:
+        lnls.append(float(re.search(r"lnL= (-?[\d.]+)", out).group(1)))
+        widths.append(int(re.search(r"local_patterns: (\d+)",
+                                    out).group(1)))
+    assert lnls[0] == lnls[1]
+    # Both processes loaded strict subsets that tile the alignment.
+    total = data.total_patterns
+    assert sum(widths) == total and all(0 < w < total for w in widths)
+    assert lnls[0] == pytest.approx(ref, abs=0.02)
+
+
+CLI_CHILD = """
+import sys; sys.path.insert(0, {repo!r})
+from examl_tpu.cli.main import main
+rc = main(["-s", {bf!r}, "-n", "MH", "-t", {tree!r}, "-f", "e",
+           "-w", {wd!r}, "--coordinator", "127.0.0.1:{port}",
+           "--nprocs", "2", "--procid", "{procid}"])
+sys.exit(rc)
+"""
+
+
+def test_multihost_cli_process0_gating(tmp_path):
+    """Only process 0 writes the primary run files; other processes
+    divert to a per-process scratch dir (the reference's processID==0
+    gating throughout axml.c)."""
+    from examl_tpu.instance import PhyloInstance
+    from examl_tpu.io.alignment import build_alignment_data
+    from examl_tpu.io.bytefile import write_bytefile
+
+    rng = np.random.default_rng(3)
+    bases = "ACGT"
+    names = [f"t{i}" for i in range(8)]
+    seqs = ["".join(bases[b] for b in rng.integers(0, 4, 600))
+            for _ in names]
+    data = build_alignment_data(names, seqs)
+    bf = str(tmp_path / "tiny.binary")
+    write_bytefile(bf, data)
+    inst = PhyloInstance(data)
+    tree = inst.random_tree(3)
+    treefile = str(tmp_path / "tiny.tree")
+    with open(treefile, "w") as f:
+        f.write(tree.to_newick(names))
+    wd = str(tmp_path / "out")
+
+    port = _free_port()
+    _launch([CLI_CHILD.format(repo=REPO, bf=bf, tree=treefile, wd=wd,
+                              port=port, procid=p) for p in range(2)],
+            ndev=4, timeout=900)
+    top = set(os.listdir(wd))
+    assert "ExaML_info.MH" in top
+    assert "ExaML_TreeFile.MH" in top          # -f e primary outputs
+    assert "ExaML_modelFile.MH" in top
+    # Non-zero processes write NO run files: RunFiles is gated off and
+    # their (diverted) scratch dir holds at most checkpoints.
+    proc1 = os.path.join(wd, ".proc1")
+    if os.path.isdir(proc1):
+        leaked = [f for f in os.listdir(proc1)
+                  if f.startswith("ExaML_") and "binaryCheckpoint" not in f]
+        assert not leaked, leaked
