@@ -44,6 +44,13 @@ def _budget() -> float:
     except ValueError:
         return 480.0
 
+
+def _num_or_null(x: float, digits: int = 3):
+    """Budget-skipped metrics are NaN internally; the JSON line must
+    stay RFC-8259 (null), not bare NaN."""
+    import math
+    return None if math.isnan(x) else round(x, digits)
+
 REPO = os.path.dirname(os.path.abspath(__file__))
 DATA = "/root/reference/testData"
 # Conservative single-socket AVX estimate until tools/bench_reference.py
@@ -181,27 +188,84 @@ def _ensure_live_backend() -> None:
     raise SystemExit("bench: no variant produced a result")
 
 
-def main() -> None:
-    _ensure_live_backend()
+def _synthetic_instance(ntaxa: int, width: int, datatype: str = "DNA"):
+    """A synthetic compute-bound benchmark alignment, built WITHOUT
+    pattern compression (random sites do not compress; weights are 1):
+    big enough that the traversal is HBM/MXU-bound rather than
+    dispatch-bound — the regime the small testData sets cannot reach
+    (SURVEY §6 recommends 3-4k DNA / ~1k AA patterns PER CORE on the
+    reference; one chip replaces a whole socket)."""
+    from examl_tpu import datatypes
+    from examl_tpu.instance import PhyloInstance
+    from examl_tpu.io.alignment import AlignmentData, PartitionData
+
+    rng = np.random.default_rng(0)
+    dt = datatypes.get(datatype)
+    if datatype == "DNA":
+        codes = rng.choice(np.array([1, 2, 4, 8], dtype=np.uint8),
+                           size=(ntaxa, width))
+        part = PartitionData(
+            name="bench", datatype=dt, model_name="DNA",
+            patterns=codes, weights=np.ones(width, dtype=np.int64),
+            empirical_freqs=np.full(4, 0.25), use_empirical_freqs=True,
+            optimize_freqs=False)
+    else:
+        codes = rng.integers(0, 20, size=(ntaxa, width), dtype=np.uint8)
+        part = PartitionData(
+            name="bench", datatype=dt, model_name="LG",
+            patterns=codes, weights=np.ones(width, dtype=np.int64),
+            empirical_freqs=np.full(20, 0.05), use_empirical_freqs=False,
+            optimize_freqs=False)
+    inst = PhyloInstance(AlignmentData([f"t{i}" for i in range(ntaxa)],
+                                       [part]))
+    return inst, inst.random_tree(0)
+
+
+LARGE_CONFIGS = {
+    # name: (ntaxa, patterns, datatype) — sized to keep the f32 CLV
+    # arena under ~8 GB HBM while holding >1e8 site-updates in flight.
+    "dna-large": (140, 524_288, "DNA"),
+    "aa-large": (140, 131_072, "AA"),
+    "dna-1000": (1_000, 131_072, "DNA"),
+}
+
+
+def _traversal_flops(fn, eng) -> float:
+    """XLA's own cost model for one chained-traversal program; NaN when
+    the API shape differs across jax versions."""
+    try:
+        cost = fn.lower(eng.clv, eng.scaler).compile().cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0]
+        return float(cost["flops"])
+    except Exception:
+        return float("nan")
+
+
+def _measure_traversal(inst, tree, budget: float) -> dict:
+    """Auto-tune the full-traversal variants (plain-XLA chunk pipeline,
+    fused Pallas chunk kernels, whole-traversal kernel) the way the
+    reference picks its fastest ISA backend; return the winner's
+    throughput plus XLA-counted FLOP/s and MFU.
+
+    n_steps dependency-chained traversals inside ONE jit returning a
+    scalar: immune to async-dispatch/transfer artifacts of the TPU
+    tunnel."""
     import jax
-
-    jax.config.update("jax_enable_x64", True)
-    inst, tree, dataset = _load_instance()
-    lnl = inst.evaluate(tree, full=True)
-
     import jax.numpy as jnp
 
-    eng = inst.engines[20]
+    lnl = inst.evaluate(tree, full=True)
+    (eng,) = inst.engines.values()
     _, entries = tree.full_traversal_centroid()
     sched = eng._fast_schedule(entries)
     chunks = sched.chunks
-    n_steps = 50
+    patterns = sum(p.width for p in inst.alignment.partitions)
+    # Scale the chain so one timing rep stays ~O(seconds) on the large
+    # configs (~2e9 site-updates per chain) while the small config keeps
+    # its 50-step chain.
+    per_trav = len(entries) * patterns * eng.R * eng.K
+    n_steps = max(5, min(50, int(2e9 / max(per_trav, 1))))
 
-    # n_steps dependency-chained traversals inside ONE jit returning a
-    # scalar: immune to async-dispatch/transfer artifacts of the TPU tunnel.
-    # Auto-tune across the available fast-path variants (plain-XLA chunk
-    # pipeline vs the fused Pallas kernels) the way the reference picks
-    # its fastest ISA backend; report the winner.
     def chained_fn(body_step):
         @jax.jit
         def chained(clv, scaler):
@@ -230,8 +294,7 @@ def main() -> None:
     # window is finite), so later variants are skipped once a number is
     # in hand and the budget is spent.  The clock includes everything
     # since process start (probe, instance build, first evaluate).
-    budget = _budget()
-    dt, variant = None, None
+    dt, variant, best_fn = None, None, None
     for name, step in variants:
         if dt is not None and _elapsed() > budget:
             sys.stderr.write(f"bench: budget spent; skipping {name}\n")
@@ -247,16 +310,54 @@ def main() -> None:
             float(fn(eng.clv, eng.scaler))
             d = time.perf_counter() - t0
             if dt is None or d < dt:
-                dt, variant = d, name
+                dt, variant, best_fn = d, name, fn
     if dt is None:
         raise RuntimeError("no traversal variant ran successfully")
     eng.use_pallas = (variant in ("pallas", "pallas-whole"))
     eng.pallas_whole = (variant == "pallas-whole")
 
-    patterns = sum(p.width for p in inst.alignment.partitions)
-    rates, states = eng.R, eng.K
-    updates = n_steps * len(entries) * patterns * rates * states
-    ups = updates / dt
+    import math
+
+    updates = n_steps * len(entries) * patterns * eng.R * eng.K
+    flops = _traversal_flops(best_fn, eng)
+    try:
+        peak = float(os.environ.get("EXAML_PEAK_FLOPS", "1.97e14"))
+    except ValueError:
+        peak = 1.97e14
+    fps = flops / dt
+    if math.isnan(fps):          # cost model unavailable: null, not NaN
+        fps = None               # (bare NaN breaks the JSON line contract)
+    return {
+        "ups": updates / dt,
+        "dt": dt,
+        "n_steps": n_steps,
+        "variant": variant,
+        "patterns": patterns,
+        "lnl": float(lnl),
+        "tflops_per_sec": (None if fps is None
+                           else round(fps / 1e12, 3)),
+        # MFU vs the bf16 MXU peak (v5e ~197 TFLOP/s; override with
+        # EXAML_PEAK_FLOPS) — a utilization DIAGNOSTIC, pessimistic for
+        # f32 programs whose true ceiling is lower (see ROOFLINE.md:
+        # this kernel is bandwidth-bound; low MFU is expected).
+        "mfu": None if fps is None else round(fps / peak, 5),
+        "eng": eng,
+        "entries": entries,
+    }
+
+
+def main() -> None:
+    _ensure_live_backend()
+    import jax
+
+    jax.config.update("jax_enable_x64", True)
+    inst, tree, dataset = _load_instance()
+    budget = _budget()
+    meas = _measure_traversal(inst, tree, budget)
+    lnl = meas["lnl"]
+    eng, entries = meas["eng"], meas["entries"]
+    dt, variant, n_steps = meas["dt"], meas["variant"], meas["n_steps"]
+    ups = meas["ups"]
 
     # Secondary metrics: per-call latency of the fused search primitives
     # (partial traversal + root lnL; partial traversal + sumtable + full
@@ -314,6 +415,32 @@ def main() -> None:
         base_src = "estimate"
 
     backend = jax.default_backend()
+
+    # Large compute-bound configs: the 1,104-pattern testData/140 is
+    # dispatch-bound (6 ms/traversal at r02) and cannot demonstrate chip
+    # capability; the synthetic half-million-pattern configs are where
+    # vs_baseline has headroom to mean something.  Accelerator runs only
+    # (a CPU host would swap on the 4-7 GB arenas), inside the budget.
+    large = {}
+    large_cfg = os.environ.get("EXAML_BENCH_LARGE", "dna-large")
+    if (backend in ("tpu", "axon") and large_cfg in LARGE_CONFIGS
+            and _elapsed() < budget):
+        try:
+            ntaxa, width, dtname = LARGE_CONFIGS[large_cfg]
+            linst, ltree = _synthetic_instance(ntaxa, width, dtname)
+            lm = _measure_traversal(linst, ltree, budget)
+            large = {"large_config": large_cfg,
+                     "large_updates_per_sec": round(lm["ups"], 1),
+                     "large_vs_baseline": round(lm["ups"] / avx, 3),
+                     "large_ms_per_traversal":
+                         round(lm["dt"] / lm["n_steps"] * 1000, 3),
+                     "large_variant": lm["variant"],
+                     "large_tflops_per_sec": lm["tflops_per_sec"],
+                     "large_mfu": lm["mfu"]}
+        except Exception as exc:                 # noqa: BLE001
+            sys.stderr.write(f"bench: large config {large_cfg} failed: "
+                             f"{exc}\n")
+            large = {"large_config": large_cfg, "large_error": str(exc)}
     # A fallback run is NEVER comparable to an accelerator number: the
     # baseline is one AVX socket and the metric races the chip against
     # it, so vs_baseline only "counts" when the run executed on tpu/axon
@@ -331,10 +458,13 @@ def main() -> None:
         "lnl": round(float(lnl), 6),
         "ms_per_traversal": round(dt / n_steps * 1000, 3),
         "traversal_variant": variant,
-        "evaluate_ms": round(eval_ms, 3),
-        "newton_branch_ms": round(newton_ms, 3),
-        "spr_scan_ms_per_node": round(scan_ms, 3),
+        "evaluate_ms": _num_or_null(eval_ms),
+        "newton_branch_ms": _num_or_null(newton_ms),
+        "spr_scan_ms_per_node": _num_or_null(scan_ms),
         "spr_scan_candidates": ncand,
+        "tflops_per_sec": meas["tflops_per_sec"],
+        "mfu": meas["mfu"],
+        **large,
         "baseline_source": base_src,
         "backend": backend,
         **({"note": "accelerator unreachable after probe+retry; "

@@ -298,7 +298,7 @@ def run_search(args, inst, files: RunFiles) -> int:
     if files.primary:       # processID==0 gating (axml.c, every output)
         _write_per_gene_trees(args, inst, tree, files)
         write_model_params(files.model_path, inst)
-    if res.good_trees:
+    if res.good_trees and files.primary:
         good = os.path.join(args.workdir,
                             f"ExaML_goodTrees.{args.run_id}")
         with open(good, "w") as f:
@@ -464,8 +464,26 @@ def main(argv=None) -> int:
             import jax
             if jax.process_count() > 1:
                 if args.model == "PSR":
-                    files.info("PSR keeps whole-file reads per process "
-                               "(host-global per-site rate state)")
+                    # PSR's rate scan fetches block-sharded per-site
+                    # arrays to the host — impossible once shards span
+                    # other processes.  Refuse at startup rather than
+                    # burning the model-opt prefix before a deep crash.
+                    files.info(
+                        "ERROR: -m PSR does not support multi-process "
+                        "execution yet (per-site rate state is "
+                        "host-global); run single-process or use GAMMA")
+                    return 1
+                from examl_tpu.io.bytefile import (PROT_MODELS,
+                                                   read_bytefile_meta)
+                meta = read_bytefile_meta(args.bytefile)
+                if any(PROT_MODELS[pm.prot] == "AUTO"
+                       for pm in meta.parts if pm.dtype_i == 2):
+                    # AUTO selection scores BIC/AICc with the weight-sum
+                    # sample size; slice-local sums would let processes
+                    # pick DIFFERENT matrices (diverging SPMD programs).
+                    files.info("AUTO protein partitions keep whole-file "
+                               "reads per process (model selection "
+                               "needs global sample sizes)")
                 else:
                     local_window = (jax.process_index(),
                                     jax.process_count())

@@ -35,10 +35,13 @@ class PartitionData:
     auto: bool = False
     branch_index: int = 0
     # Set by selective byteFile reads (io/bytefile.py): the partition's
-    # FULL pattern count and this slice's starting column within it.
-    # None/0 means `patterns` holds the whole partition.
+    # FULL pattern count, this slice's starting column within it, and
+    # the GLOBAL weight sum (checkpoint fingerprints must not depend on
+    # which slice a process holds).  None/0 means `patterns` holds the
+    # whole partition.
     global_width: int | None = None
     global_col_offset: int = 0
+    global_weight_sum: int | None = None
 
     @property
     def width(self) -> int:

@@ -207,8 +207,8 @@ def _read_columns(f, meta: ByteFileMeta, pm: BytePartMeta, lo: int,
 
 
 def _part_from_meta(pm: BytePartMeta, patterns: np.ndarray,
-                    weights: np.ndarray,
-                    col_offset: int = 0) -> PartitionData:
+                    weights: np.ndarray, col_offset: int = 0,
+                    global_weight_sum: int | None = None) -> PartitionData:
     dt = datatypes.get(DATATYPE_NAME[pm.dtype_i])
     if dt.name == "AA":
         model_name = PROT_MODELS[pm.prot]
@@ -237,7 +237,7 @@ def _part_from_meta(pm: BytePartMeta, patterns: np.ndarray,
         optimize_freqs=pm.opt_freqs,
         lg4=model_name in ("LG4M", "LG4X"), auto=model_name == "AUTO",
         global_width=pm.width if patterns.shape[1] != pm.width else None,
-        global_col_offset=col_offset)
+        global_col_offset=col_offset, global_weight_sum=global_weight_sum)
 
 
 def read_bytefile(path: str) -> AlignmentData:
@@ -269,11 +269,18 @@ def read_bytefile_slice(path: str,
     `columns` maps partition index -> (col_lo, col_hi) relative to the
     partition; partitions absent from the map come back with width 0
     (metadata — models, frequencies, names — is always global).  Host
-    memory and IO are proportional to the WINDOW, not the alignment:
-    this is the TPU-native `readMyData` (`byteFile.c:278-382`), where
-    each MPI rank seeks and reads only its assigned site blocks."""
+    memory and IO are proportional to the WINDOW, not the alignment
+    (the weights SECTION is still read whole — 4 bytes/pattern, needed
+    for process-count-invariant checkpoint fingerprints): this is the
+    TPU-native `readMyData` (`byteFile.c:278-382`), where each MPI rank
+    seeks and reads only its assigned site blocks."""
     meta = read_bytefile_meta(path)
     with open(path, "rb") as f:
+        f.seek(meta.weights_offset)
+        wbytes = f.read(4 * meta.num_pattern)
+        if len(wbytes) != 4 * meta.num_pattern:
+            raise ValueError("truncated byteFile")
+        all_weights = np.frombuffer(wbytes, dtype="<i4")
         parts: List[PartitionData] = []
         for gid, pm in enumerate(meta.parts):
             lo, hi = columns.get(gid, (0, 0))
@@ -282,11 +289,12 @@ def read_bytefile_slice(path: str,
                     f"partition {gid}: window [{lo},{hi}) outside "
                     f"[0,{pm.width})")
             patterns = _read_columns(f, meta, pm, lo, hi)
-            f.seek(meta.weights_offset + 4 * (pm.lower + lo))
-            wbytes = f.read(4 * (hi - lo))
-            weights = np.frombuffer(wbytes, dtype="<i4").astype(np.int64)
+            weights = all_weights[pm.lower + lo:pm.lower + hi].astype(
+                np.int64)
+            gsum = int(all_weights[pm.lower:pm.upper].sum())
             parts.append(_part_from_meta(pm, patterns, weights,
-                                         col_offset=lo))
+                                         col_offset=lo,
+                                         global_weight_sum=gsum))
     return AlignmentData(meta.taxon_names, parts)
 
 

@@ -240,24 +240,39 @@ def mod_opt(inst: PhyloInstance, tree: Tree, likelihood_epsilon: float,
         auto_protein_fn = partial(
             auto_protein,
             criterion=getattr(inst, "auto_prot_criterion", "ml"))
+    import os
+
+    def dbg(tag: str) -> None:
+        # EXAML_DEBUG_MODOPT=1: per-phase lnL trace, the mirror of the
+        # reference's -D_DEBUG_MOD_OPT printf trail — phase-by-phase
+        # diffable against an instrumented reference build.
+        if os.environ.get("EXAML_DEBUG_MODOPT"):
+            print(f"modopt {tag}: {inst.likelihood:.6f}", flush=True)
+
     while max_rounds > 0:
         max_rounds -= 1
         current = inst.likelihood
+        dbg("start")
         opt_rates(inst, tree)
+        dbg("after rates")
         if auto_protein_fn is not None:
             auto_protein_fn(inst, tree)
         tree_evaluate(inst, tree, 0.0625)
+        dbg("after br-len 1")
         opt_freqs(inst, tree)
         tree_evaluate(inst, tree, 0.0625)
+        dbg("after freqs")
         if getattr(inst, "psr", False):
             if inst.cat_opt_rounds < 3:
                 from examl_tpu.optimize.psr import optimize_rate_categories
                 optimize_rate_categories(inst, tree)
                 inst.cat_opt_rounds += 1
+                dbg("after cat-opt")
         else:
             opt_alphas(inst, tree)
             opt_lg4x(inst, tree)
-        tree_evaluate(inst, tree, 0.1)
+            tree_evaluate(inst, tree, 0.1)
+            dbg("after alphas + br-len 2")
         if checkpoint_cb is not None:
             checkpoint_cb("MOD_OPT", {})
         if abs(current - inst.likelihood) <= likelihood_epsilon:

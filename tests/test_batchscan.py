@@ -124,6 +124,7 @@ def test_down_entries_dependency_ordered():
     assert checked >= 3
 
 
+@pytest.mark.slow
 def test_batched_thorough_matches_sequential():
     """The thorough arm (triangle NR + localSmooth + evaluate) batched
     on device must reproduce the sequential per-candidate lnLs and the
@@ -174,6 +175,7 @@ def test_thorough_gating(monkeypatch):
     assert not thorough_batched_ok(inst)
 
 
+@pytest.mark.slow
 def test_thorough_e2e_cycle(monkeypatch):
     """A small thorough SPR cycle with the batched arm forced improves
     lnL like the sequential one."""

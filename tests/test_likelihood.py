@@ -47,6 +47,7 @@ def test_lnl_alpha_and_rates(data49, tree49_text):
     assert abs(lnl - ref) / abs(ref) < 1e-8, (lnl, ref)
 
 
+@pytest.mark.slow
 def test_root_branch_invariance(data49, tree49_text):
     """lnL must not depend on which branch evaluateGeneric roots at."""
     inst = PhyloInstance(data49)

@@ -87,12 +87,16 @@ def test_psr_endpoint_matches_reference():
     inst.evaluate(tree, full=True)
     tree_evaluate(inst, tree, 1.0)
     mod_opt(inst, tree, 0.1)
-    # Measured endpoints: ours -14763.8 vs reference -14702.97 — two
-    # local optima of the same PSR model 0.4% apart (the categorization
-    # pipeline itself matches round-for-round: our cat-opt rounds land at
-    # -15805/-14881/-14810 vs the reference's -15860/-14903/-14776).
+    # Measured endpoints: ours -14710.82 vs reference -14702.97 (cat-opt
+    # rounds -15805/-14881/-14772 vs -15860/-14903/-14776; both then
+    # grind ~30 GTR-rate+branch rounds to the same 0.1-lnL convergence
+    # rule — EXAML_DEBUG_MODOPT=1 prints the phase trail to diff against
+    # a -D_DEBUG_MOD_OPT reference build).  The residual ~8 lnL is two
+    # nearby optima of the per-site-rate lattice, not a pipeline gap:
+    # round-1 'after rates' already differs (+25.8 in our favor) because
+    # the vectorized GTR Brent converges tighter than the reference's.
     assert inst.likelihood == pytest.approx(_fixture_lnl("ref49psr"),
-                                            abs=80.0)
+                                            abs=10.0)
 
 
 def _ref_tree_eval(tmp, aln, model, tree) -> float:

@@ -54,6 +54,7 @@ def test_tree_build_traverse_5k(caterpillar_newick):
     assert text.count(",") == N - 1
 
 
+@pytest.mark.slow
 def test_random_tree_5k():
     names = [f"t{i}" for i in range(N)]
     tree = Tree.random(names, seed=1)

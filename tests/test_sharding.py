@@ -22,8 +22,13 @@ from examl_tpu.parallel.sharding import (default_site_sharding, make_mesh,
 
 from tests.conftest import TESTDATA
 
-pytestmark = pytest.mark.skipif(
-    len(jax.devices()) < 8, reason="needs 8 (virtual) devices")
+pytestmark = [
+    pytest.mark.skipif(len(jax.devices()) < 8,
+                       reason="needs 8 (virtual) devices"),
+    # ~6 min of 8-virtual-device programs on one CPU: slow tier (the
+    # driver's dryrun_multichip covers the sharded path in CI cadence).
+    pytest.mark.slow,
+]
 
 
 @pytest.fixture(scope="module")
