@@ -65,11 +65,7 @@ def stack_models(models: Sequence[ModelParams],
     )
 
 
-def _next_pow2(n: int) -> int:
-    p = 1
-    while p < n:
-        p *= 2
-    return p
+from examl_tpu.utils import next_pow2 as _next_pow2
 
 
 def _bucket_len(n: int) -> int:
@@ -577,8 +573,19 @@ class LikelihoodEngine:
         normal traversals never touch rows above their original arena, so
         the region is free scratch between scan dispatches."""
         if self.save_memory:
-            raise RuntimeError("batched scan is unavailable under -S "
-                               "(SEV pools have no scan region)")
+            base = self.sev.ensure_scan_rows(n)
+            if self.sev.num_rows > self.num_rows:
+                # The scaler stays DENSE under -S ([rows, B, lane] int32,
+                # ~1/64 the bytes of a CLV row): it must grow with the
+                # pool's scan rows or traverse_pooled's scatter silently
+                # drops scan-row scaler writes (JAX OOB scatter = drop)
+                # and candidate lnLs lose their scale counts.
+                grow = self.sev.num_rows - self.num_rows
+                spad = jnp.zeros((grow,) + self.scaler.shape[1:],
+                                 self.scaler.dtype)
+                self.scaler = jnp.concatenate([self.scaler, spad])
+                self.num_rows = self.sev.num_rows
+            return base
         if not hasattr(self, "_scan_base"):
             self._scan_base = self.num_rows
             self._scan_cap = 0
@@ -645,9 +652,14 @@ class LikelihoodEngine:
 
     def batched_scan(self, plan) -> np.ndarray:
         """Uppass traversal + all candidate insertion scores in one
-        dispatch; returns this engine's per-candidate lnL sums [N]."""
+        dispatch; returns this engine's per-candidate lnL sums [N].
+        Works on the dense arena and on -S SEV pools alike (gap bits for
+        the orientation fixes update first; the scan region is carved
+        from the pool by ensure_scan_rows)."""
         from examl_tpu.search import batchscan
 
+        if self.save_memory:
+            self.sev.update_for_entries(plan.down_entries)
         base = self.ensure_scan_rows(len(plan.up_entries))
         tv = self._scan_traversal_arrays(plan.down_entries,
                                          plan.up_entries, base)
@@ -659,22 +671,27 @@ class LikelihoodEngine:
             zc[i] = _z_slots(c.z, C)
         fn = batchscan.scan_program(self, n_chunks)
         zp = jnp.asarray(_z_slots(plan.zp, C), dtype=self.dtype)
-        self.clv, self.scaler, lnls = fn(
-            self.clv, self.scaler, tv,
+        buf, aux = self._state()
+        buf, self.scaler, lnls = fn(
+            buf, self.scaler, aux, tv,
             jnp.asarray(qg.reshape(n_chunks, T)),
             jnp.asarray(upg.reshape(n_chunks, T)),
             jnp.asarray(zc.reshape(n_chunks, T, C), dtype=self.dtype),
             jnp.int32(self._gidx(plan.s_num)), zp,
             self.models, self.block_part, self.weights, self.tips,
             self.site_rates)
+        self._set_buf(buf)
         return np.asarray(lnls)[:len(plan.candidates)]
 
     def batched_thorough(self, plan):
         """Thorough-arm companion of `batched_scan`: triangle Newton,
         localSmooth, and scoring per candidate in one dispatch; returns
-        (lnls [N], smoothed branch triplets [N, 3])."""
+        (lnls [N], smoothed branch triplets [N, 3]).  Dense arenas only
+        (spr.thorough_batched_ok gates -S to the sequential thorough
+        primitives)."""
         from examl_tpu.search import batchscan
 
+        assert not self.save_memory, "batched thorough arm is dense-only"
         base = self.ensure_scan_rows(len(plan.up_entries))
         tv = self._scan_traversal_arrays(plan.down_entries,
                                          plan.up_entries, base)

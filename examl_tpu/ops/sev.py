@@ -22,6 +22,36 @@ paying for lane padding.  Granularity note: a block with ANY non-gap site
 is stored whole — the reference compacts per site, so its ratio is better
 on alignments whose gaps do not align to 128-column runs; block
 granularity is what keeps every shape static for XLA.
+
+SEV x sharding — design (not yet wired):
+The obstacle is ONLY that the pool's cell axis is irregular while the
+mesh shards the block axis.  The composition that preserves both:
+
+1. Partition the block axis over the mesh exactly as the dense path
+   does (contiguous ranges of B, `parallel/packing.py`).
+2. Give each device ITS OWN pool over ITS block range: gap bitsets are
+   per-(node, block), so cell allocation decomposes cleanly by block —
+   no cell ever crosses a device boundary by construction.
+3. Run the whole engine under `shard_map` over the sites axis: inside
+   the mapped program every reference to (pool, slot maps) is the
+   device-local shard, the traversal kernel is IDENTICAL to today's
+   single-device pooled kernel, and the only cross-device communication
+   stays the per-partition lnL/derivative `psum` the dense path already
+   does.  Slot maps become per-device [rows, B_local] int32 arrays built
+   by the host from the same bitsets, stacked [ndev, rows, B_local].
+4. Pool capacity must be per-device-uniform for static shapes: cap =
+   max over devices of that device's cell count (pow2-bucketed like
+   today); gappy regions are typically spatially clustered, so the
+   waste is bounded by one growth bucket.
+5. Multi-host selective loading composes for free: gap bitsets derive
+   from tip codes, which the sliced reader already delivers per block
+   range (`io/bytefile.py`).
+
+Cost estimate: the engine change is mechanical (today's `_state()`
+tuple moves inside `shard_map`); the host change is indexing bitsets by
+block range.  Deferred because `-S` exists to save MEMORY, and the
+first-order memory win at scale is per-process selective loading +
+sharded dense arenas, which already landed this round.
 """
 
 from __future__ import annotations
@@ -96,6 +126,34 @@ class SevState:
             out[i] = self.next_cell
             self.next_cell += 1
         return out
+
+    # -- batched-scan scratch region ----------------------------------------
+
+    def ensure_scan_rows(self, n: int) -> int:
+        """Carve a DENSE scratch scan region of >= n rows out of the pool
+        (pow2 bucketed like the dense arena's region): scan rows get a
+        real cell for EVERY block — uppass CLVs mix the whole far side of
+        the tree, so they have no gap structure to exploit — appended
+        below the node rows in the slot maps.  This is what lets the
+        one-dispatch SPR scan run under -S (the reference's `-S` runs its
+        normal SPR loop on gapped kernels; here the batched scan IS the
+        SPR loop, so the pool carves it a region).  Returns the region's
+        base row index."""
+        if not hasattr(self, "scan_base"):
+            self.scan_base = self.num_rows
+            self.scan_cap = 0
+        if n > self.scan_cap:
+            from examl_tpu.utils import next_pow2
+            grow = next_pow2(n) - self.scan_cap
+            self.node_gap = np.concatenate(
+                [self.node_gap, np.zeros((grow, self.B), dtype=bool)])
+            new_cells = self._alloc(grow * self.B).reshape(grow, self.B)
+            self.cell_of = np.concatenate([self.cell_of, new_cells])
+            self.num_rows += grow
+            self.scan_cap += grow
+            self.dirty = True
+        self.sync()
+        return self.scan_base
 
     # -- device sync ---------------------------------------------------------
 

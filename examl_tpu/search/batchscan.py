@@ -217,7 +217,11 @@ def scan_program(eng, n_chunks: int):
     by the engine's bucketed traversal arrays.  Under PSR the engine's
     per-site rate multipliers ride along and every P application uses
     the factorized per-site form (`apply_p_factorized`); the GAMMA path
-    keeps the batched P-matrix contraction."""
+    keeps the batched P-matrix contraction.  The traversal and every CLV
+    gather go through the engine's state-agnostic primitives, so the
+    same program text serves the dense arena (aux=()) and the -S SEV
+    pool (aux=(slot_read, slot_write), scan region carved from the
+    pool)."""
     import jax
     import jax.numpy as jnp
 
@@ -232,11 +236,11 @@ def scan_program(eng, n_chunks: int):
     ntips = eng.ntips
     psr = eng.psr
 
-    def impl(clv, scaler, tv, qg, upg, zc, sg, zp, dm, block_part,
+    def impl(clv, scaler, aux, tv, qg, upg, zc, sg, zp, dm, block_part,
              weights, tips, sr_rates):
-        clv, scaler = kernels.traverse(dm, block_part, tips, clv, scaler,
-                                       tv, scale_exp, ntips, sr_rates)
-        xs, ss = kernels.gather_child(tips, clv, scaler, sg, ntips)
+        clv, scaler = eng._traverse_kernel(clv, aux, scaler, tv, dm,
+                                           block_part, tips, sr_rates)
+        xs, ss = eng._gather(clv, aux, scaler, sg, tips)
         if psr:
             ds = kernels.psr_decay(dm, block_part, sr_rates, zp)
             u = kernels.apply_p_factorized(dm, block_part, ds, xs)
@@ -250,8 +254,8 @@ def scan_program(eng, n_chunks: int):
 
         def chunk(carry, args):
             qg_c, upg_c, z_c = args                       # [T], [T], [T,C]
-            xq, sq = kernels.gather_child(tips, clv, scaler, qg_c, ntips)
-            xr, sr = kernels.gather_child(tips, clv, scaler, upg_c, ntips)
+            xq, sq = eng._gather(clv, aux, scaler, qg_c, tips)
+            xr, sr = eng._gather(clv, aux, scaler, upg_c, tips)
             if psr:
                 d_c = jax.vmap(lambda zz: kernels.psr_decay(
                     dm, block_part, sr_rates, zz))(z_c)   # [T,B,l,R,K]

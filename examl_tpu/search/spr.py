@@ -381,8 +381,8 @@ def dfs_slot_order(tree: Tree) -> List[Node]:
 
 def batched_scan_enabled(inst: PhyloInstance) -> bool:
     """True when the lazy arm uses the one-dispatch-per-pruned-node scan
-    (search/batchscan.py), GAMMA or PSR; -S engines keep the sequential
-    primitives (pools have no scan region).
+    (search/batchscan.py) — GAMMA, PSR, dense arenas AND -S SEV pools
+    (the scan region is carved from the pool, engine.ensure_scan_rows).
 
     Like the thorough arm, the lazy scan trades compute (the whole
     radius window, no mid-descent lnL-cutoff early-outs) for dispatch
@@ -390,10 +390,9 @@ def batched_scan_enabled(inst: PhyloInstance) -> bool:
     tunnel) and loses on host CPU where the sequential cutoff arm's
     skipped work is the cheaper currency -- so by default it is gated
     to accelerator devices.  EXAML_BATCH_SCAN=0 forces sequential
-    everywhere; =1 forces the batched scan on any backend (the -S
-    structural restriction still holds)."""
+    everywhere; =1 forces the batched scan on any backend."""
     import os
-    if not _scan_structurally_ok(inst):
+    if os.environ.get("EXAML_BATCH_SCAN") == "0":
         return False
     if os.environ.get("EXAML_BATCH_SCAN") == "1":
         return True
@@ -401,22 +400,28 @@ def batched_scan_enabled(inst: PhyloInstance) -> bool:
 
 
 def _on_accelerator(inst: PhyloInstance) -> bool:
-    """True when every engine's CLV arena lives on an accelerator device
-    (the placement decision, not the default backend — a
-    jax.default_device(cpu) fallback leaves default_backend()=='tpu')."""
+    """True when every engine's CLV state (dense arena, or the SEV pool
+    under -S) lives on an accelerator device (the placement decision,
+    not the default backend — a jax.default_device(cpu) fallback leaves
+    default_backend()=='tpu')."""
     for e in inst.engines.values():
-        if e.clv is None:
+        buf = e.clv
+        if buf is None and getattr(e, "sev", None) is not None:
+            e.sev.sync()
+            buf = e.sev.pool
+        if buf is None:
             return False
-        platform = next(iter(e.clv.devices())).platform
+        platform = next(iter(buf.devices())).platform
         if platform not in ("tpu", "axon"):
             return False
     return True
 
 
 def _scan_structurally_ok(inst: PhyloInstance) -> bool:
-    """Hard constraints of the scan region, shared by both batched arms:
-    -S pools have no scan region; EXAML_BATCH_SCAN=0 forces sequential
-    primitives everywhere."""
+    """Hard constraints of the batched THOROUGH arm: its on-device
+    triangle/smoothing Newton programs are dense-only (-S keeps the
+    sequential thorough primitives); EXAML_BATCH_SCAN=0 forces
+    sequential primitives everywhere."""
     import os
     if os.environ.get("EXAML_BATCH_SCAN") == "0":
         return False
@@ -553,10 +558,10 @@ def rearrange_auto(inst: PhyloInstance, tree: Tree, ctx: SprContext,
     node for both arms.  The lazy scan batches for GAMMA and PSR alike;
     the thorough arm batches on accelerator devices for single-bucket,
     single-slot GAMMA instances (thorough_batched_ok).  Sequential
-    primitives remain for -S (no scan region), for mixed state buckets
-    and per-partition branches (the on-device Newton loops cannot sum
-    derivatives across engines), and wherever the env switches force
-    them."""
+    primitives remain for the -S THOROUGH arm (the batched lazy scan
+    works on SEV pools), for mixed state buckets and per-partition
+    branches (the on-device Newton loops cannot sum derivatives across
+    engines), and wherever the env switches force them."""
     if ctx.thorough:
         if thorough_batched_ok(inst):
             return rearrange_batched_thorough(inst, tree, ctx, p,

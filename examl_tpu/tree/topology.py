@@ -183,13 +183,25 @@ class Tree:
         hookup(center, tree.nodep[int(order[0])], tree.default_z())
         hookup(center.next, tree.nodep[int(order[1])], tree.default_z())
         hookup(center.next.next, tree.nodep[int(order[2])], tree.default_z())
+        # Incremental branch list: each insertion splits one branch into
+        # three, so the candidate set updates in O(1) instead of a full
+        # all_branches() sweep — O(n) total, which is what makes the
+        # reference-scale ~120k-taxon regime (SURVEY §6) reachable
+        # (the O(n^2) sweep took hours at 50k taxa).
+        branches = [(center, center.back),
+                    (center.next, center.next.back),
+                    (center.next.next, center.next.next.back)]
         for num in order[3:]:
-            branches = tree.all_branches()
-            p, q = branches[rng.integers(len(branches))]
+            i = int(rng.integers(len(branches)))
+            p, q = branches[i]
             inner = tree.new_inner()
             hookup(inner.next, p, p.z)
             hookup(inner.next.next, q, tree.default_z())
-            hookup(inner, tree.nodep[int(num)], tree.default_z())
+            tip = tree.nodep[int(num)]
+            hookup(inner, tip, tree.default_z())
+            branches[i] = (p, p.back)
+            branches.append((q, q.back))
+            branches.append((tip, tip.back))
         tree._check_connected()
         return tree
 

@@ -7,6 +7,16 @@ from typing import Sequence
 import numpy as np
 
 
+def next_pow2(n: int) -> int:
+    """Smallest power of two >= n (shape-bucketing helper: the dense and
+    -S scan regions, wave widths, and chunk counts all bucket on it so
+    recompilation stays O(log n))."""
+    p = 1
+    while p < n:
+        p *= 2
+    return p
+
+
 def z_slots(z: "Sequence[float] | float", num_slots: int) -> np.ndarray:
     """Normalize a branch-length vector to [num_slots] float64.
 

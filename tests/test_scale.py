@@ -92,3 +92,40 @@ def test_native_newick_scanner_parity():
     for bad in ("((A,B)(C,D));", "(A,B", "(A:x,B);"):
         with pytest.raises(ValueError):
             _parse_newick_native(bad)
+
+
+@pytest.mark.slow
+def test_host_paths_50k_taxa_within_budget():
+    """The host-side pipeline at 50k taxa (reference ambition ~120k,
+    SURVEY §6) stays interactive: random-addition build is O(n) via the
+    incremental branch list, and one full-tree fast-path schedule builds
+    in about half a second (measured 0.52-0.61 s warm; generous bounds
+    absorb CI host contention)."""
+    import time
+
+    import jax.numpy as jnp
+
+    from examl_tpu.ops import fastpath
+
+    n = 50_000
+    names = [f"t{i}" for i in range(n)]
+    t0 = time.time()
+    tree = Tree.random(names, seed=1)
+    t_build = time.time() - t0
+    t0 = time.time()
+    _, entries = tree.full_traversal()
+    t_trav = time.time() - t0
+    assert len(entries) == n - 2
+    t0 = time.time()
+    waves = Tree.schedule_waves(entries)
+    t_waves = time.time() - t0
+    assert sum(len(w) for w in waves) == n - 2
+    fastpath.build_schedule(entries, n, 1, jnp.float32)   # warm jax
+    t0 = time.time()
+    sched = fastpath.build_schedule(entries, n, 1, jnp.float32)
+    t_sched = time.time() - t0
+    assert len(sched.row_of) == n - 2
+    assert t_build < 5.0, t_build            # measured 0.56 s
+    assert t_trav < 2.0, t_trav              # measured 0.13 s
+    assert t_waves < 1.0, t_waves            # measured 0.02 s
+    assert t_sched < 3.0, t_sched            # measured 0.52-0.61 s

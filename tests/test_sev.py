@@ -141,6 +141,85 @@ def test_sev_topology_change_reallocates(gappy):
     assert l2 == pytest.approx(l1, rel=1e-12, abs=1e-8)
 
 
+def test_sev_batched_scan_matches_dense(gappy):
+    """The one-dispatch SPR radius scan on an SEV pool (scan region
+    carved from the pool, engine.ensure_scan_rows) returns the same
+    per-candidate lnLs as the identical plan on a dense arena — in a
+    RESCALING regime (z=0.05 everywhere), so scan-region scaler growth
+    is load-bearing, not vacuously zero."""
+    from examl_tpu.search import batchscan, spr
+
+    # gene0 covers every taxon (deep caterpillar -> rescaling fires);
+    # gene1 covers half (gap structure -> the pool actually indirects).
+    rng = np.random.default_rng(4)
+    ntaxa, gs = 24, 256
+    names = [f"t{i}" for i in range(ntaxa)]
+    seqs = []
+    for i in range(ntaxa):
+        g0 = "".join("ACGT"[b] for b in rng.integers(0, 4, gs))
+        g1 = ("".join("ACGT"[b] for b in rng.integers(0, 4, gs))
+              if i < ntaxa // 2 else "-" * gs)
+        seqs.append(g0 + g1)
+    import os
+    import tempfile
+
+    from examl_tpu.io.partitions import parse_partition_file
+    mp = os.path.join(tempfile.mkdtemp(), "p.model")
+    with open(mp, "w") as f:
+        f.write(f"DNA, g0 = 1-{gs}\nDNA, g1 = {gs + 1}-{2 * gs}\n")
+    import jax.numpy as jnp
+    data = build_alignment_data(names, seqs,
+                                specs=parse_partition_file(mp))
+    # f32: the conftest's x64 default would push the rescale threshold
+    # beyond what a 24-taxon caterpillar reaches.
+    dense = PhyloInstance(data, dtype=jnp.float32)
+    sev = PhyloInstance(data, dtype=jnp.float32, save_memory=True)
+    parts = ["(t0:0.05,t1:0.05)"]
+    for i in range(2, ntaxa):                # caterpillar: maximum depth
+        parts.append(f"({parts[-1]}:0.05,t{i}:0.05)")
+        parts.pop(-2)
+    newick = parts[-1] + ";"
+    lnls = {}
+    for inst in (dense, sev):
+        tree = inst.tree_from_newick(newick)
+        inst.evaluate(tree, full=True)
+        (eng,) = inst.engines.values()
+        assert int(np.asarray(eng.scaler).sum()) > 0   # scaling active
+        ctx = spr.SprContext(inst, thorough=False, do_cutoff=False)
+        c = tree.centroid_branch()
+        p = c if not tree.is_tip(c.number) else c.back
+        q1, q2 = p.next.back, p.next.next.back
+        p1z, p2z = list(q1.z), list(q2.z)
+        spr.remove_node(inst, tree, ctx, p)
+        plan = batchscan.plan_for_endpoints(inst, tree, p, q1, q2, 1, 6)
+        assert plan is not None and plan.candidates
+        lnls[inst is sev] = batchscan.run_plan(inst, tree, plan)
+        hookup(p.next, q1, p1z)
+        hookup(p.next.next, q2, p2z)
+        inst.new_view(tree, p)
+    np.testing.assert_allclose(lnls[True], lnls[False],
+                               rtol=1e-6, atol=5e-4)
+
+
+@pytest.mark.slow
+def test_sev_batched_search_improves(gappy, monkeypatch):
+    """-S search with the batched lazy arm FORCED on (the accelerator
+    default keeps it sequential on CPU) improves lnL end-to-end."""
+    from examl_tpu.search.raxml_search import SearchOptions, compute_big_rapid
+    from examl_tpu.search.spr import batched_scan_enabled
+
+    monkeypatch.setenv("EXAML_BATCH_SCAN", "1")
+    sev = PhyloInstance(gappy, save_memory=True)
+    assert batched_scan_enabled(sev)
+    tree = sev.random_tree(5)
+    start = sev.evaluate(tree, full=True)
+    res = compute_big_rapid(sev, tree,
+                            SearchOptions(initial=2, initial_set=True,
+                                          max_rearrange=4,
+                                          estimate_model=False))
+    assert res.likelihood > start
+
+
 @pytest.mark.slow
 def test_sev_search_smoke(gappy):
     """A short -f d style search runs under SEV and improves lnL."""
