@@ -188,7 +188,8 @@ def _ensure_live_backend() -> None:
     raise SystemExit("bench: no variant produced a result")
 
 
-def _synthetic_instance(ntaxa: int, width: int, datatype: str = "DNA"):
+def _synthetic_instance(ntaxa: int, width: int, datatype: str = "DNA",
+                        dtype=None):
     """A synthetic compute-bound benchmark alignment, built WITHOUT
     pattern compression (random sites do not compress; weights are 1):
     big enough that the traversal is HBM/MXU-bound rather than
@@ -217,7 +218,7 @@ def _synthetic_instance(ntaxa: int, width: int, datatype: str = "DNA"):
             empirical_freqs=np.full(20, 0.05), use_empirical_freqs=False,
             optimize_freqs=False)
     inst = PhyloInstance(AlignmentData([f"t{i}" for i in range(ntaxa)],
-                                       [part]))
+                                       [part]), dtype=dtype)
     return inst, inst.random_tree(0)
 
 

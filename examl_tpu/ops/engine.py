@@ -129,6 +129,23 @@ class LikelihoodEngine:
         # that rejected HIGH globally was dominated by those).  CPU ignores
         # the knob (always true f32/f64).  EXAML_DOT_PRECISION overrides.
         import os as _pos
+        # CLV STORAGE dtype (ROOFLINE.md lever 3): the newview kernel is
+        # HBM-bandwidth-bound, so storing the arena in bf16 (compute
+        # stays f32: gathers upcast after the load, stores downcast
+        # before it) halves bytes/update and doubles the throughput
+        # ceiling.  Opt-in via EXAML_CLV_DTYPE=bf16 — each CLV cell is
+        # rounded once per node level, so the lnL bound must be
+        # re-measured per analysis (see NUMERICS.md).  A non-f32 compute
+        # dtype (f64 parity runs) ignores the knob: a globally-exported
+        # env var must not crash unrelated jobs.
+        _clv_env = _pos.environ.get("EXAML_CLV_DTYPE", "")
+        if _clv_env in ("bf16", "bfloat16") and self.dtype == jnp.float32:
+            self.storage_dtype = jnp.dtype(jnp.bfloat16)
+        elif _clv_env in ("", "0", "same", "bf16", "bfloat16"):
+            self.storage_dtype = self.dtype
+        else:
+            raise ValueError(f"EXAML_CLV_DTYPE={_clv_env!r}: expected "
+                             "bf16/bfloat16 or unset")
         _prec = _pos.environ.get("EXAML_DOT_PRECISION", "high").upper()
         if _prec not in ("DEFAULT", "HIGH", "HIGHEST"):
             raise ValueError(
@@ -184,12 +201,12 @@ class LikelihoodEngine:
             self.clv = None
             self.sev = SevState(bucket.tip_codes, self._undetermined_code(),
                                 self.num_rows, B, lane, self.R, self.K,
-                                self.dtype)
+                                self.storage_dtype)
         else:
             self.sev = None
             self.clv = self._zeros_sharded(
-                (self.num_rows, B, lane, self.R, self.K), self.dtype,
-                lambda s: s.clv)
+                (self.num_rows, B, lane, self.R, self.K),
+                self.storage_dtype, lambda s: s.clv)
         self.scaler = self._zeros_sharded((self.num_rows, B, lane),
                                           jnp.int32, lambda s: s.scaler)
         # Fused Pallas chunk kernels, gated on where the CLV arena actually
@@ -204,6 +221,7 @@ class LikelihoodEngine:
             platform = next(iter(self.clv.devices())).platform
             self.use_pallas = (
                 self._want_pallas and self.dtype == jnp.float32
+                and self.storage_dtype == self.dtype
                 and sharding is None
                 and (self.pallas_interpret
                      or platform in ("tpu", "axon")))

@@ -167,7 +167,8 @@ def run_chunks(models: kernels.DeviceModels, block_part: jax.Array,
     RK = R * K
     M = models.eign.shape[0]
     C = tips.table.shape[0]
-    eyeR = jnp.eye(R, dtype=clv.dtype)
+    cdt = tips.table.dtype        # COMPUTE dtype; the arena may store
+    eyeR = jnp.eye(R, dtype=cdt)  # narrower (bf16 tier, EXAML_CLV_DTYPE)
     HI = jax.lax.Precision.HIGHEST
 
     def tip_child(p, code):
@@ -176,7 +177,7 @@ def run_chunks(models: kernels.DeviceModels, block_part: jax.Array,
         W = code.shape[0]
         ump = jnp.einsum("ck,wmrak->wmcra", tips.table, p, precision=HI)
         ump = ump.reshape(W, M, C, RK)[:, block_part]       # [W,B,C,RK]
-        oh = jax.nn.one_hot(tips.codes[code], C, dtype=clv.dtype)
+        oh = jax.nn.one_hot(tips.codes[code], C, dtype=cdt)
         return jax.lax.dot_general(oh, ump,
                                    (((3,), (2,)), ((0, 1), (0, 1))),
                                    precision=precision)
@@ -187,12 +188,12 @@ def run_chunks(models: kernels.DeviceModels, block_part: jax.Array,
         W = idx.shape[0]
         pb = jnp.einsum("wmrak,rs->wmrksa", p, eyeR).reshape(W, M, RK, RK)
         pb = pb[:, block_part]                              # [W,B,RK,RK]
-        x = clv[idx].reshape(W, B, lane, RK)
+        x = clv[idx].astype(cdt).reshape(W, B, lane, RK)
         return jax.lax.dot_general(x, pb,
                                    (((3,), (2,)), ((0, 1), (0, 1))),
                                    precision=precision)
 
-    minlik, two_e, _ = kernels.scale_constants(clv.dtype, scale_exp)
+    minlik, two_e, _ = kernels.scale_constants(cdt, scale_exp)
     for ch in chunks:
         pl = kernels.p_matrices_wave(models, ch.zl)         # [W,M,R,K,K]
         pr = kernels.p_matrices_wave(models, ch.zr)
@@ -215,6 +216,7 @@ def run_chunks(models: kernels.DeviceModels, block_part: jax.Array,
         sc = sc + needs.astype(jnp.int32)
         z0 = jnp.zeros((), ch.base.dtype)
         clv = jax.lax.dynamic_update_slice(
-            clv, v.reshape(W, B, lane, R, K), (ch.base, z0, z0, z0, z0))
+            clv, v.reshape(W, B, lane, R, K).astype(clv.dtype),
+            (ch.base, z0, z0, z0, z0))
         scaler = jax.lax.dynamic_update_slice(scaler, sc, (ch.base, z0, z0))
     return clv, scaler

@@ -106,7 +106,10 @@ def gather_child(tips: TipState, clv: jax.Array, scaler: jax.Array,
         tip_clv[..., :, :, None, :],
         tip_clv.shape[:-1] + (R, tip_clv.shape[-1]))
     inner_idx = jnp.clip(idx - ntips, 0, clv.shape[0] - 1)
-    inner_clv = clv[inner_idx]
+    # astype: the arena may store CLVs in a narrower dtype (bf16 storage
+    # tier, EXAML_CLV_DTYPE) — the cast happens after the (halved) HBM
+    # read and is a no-op when storage == compute.
+    inner_clv = clv[inner_idx].astype(tips.table.dtype)
     sel = is_tip[..., None, None, None, None]
     x = jnp.where(sel, tip_clv, inner_clv)
     sc = jnp.where(is_tip[..., None, None], 0, scaler[inner_idx])
@@ -257,7 +260,8 @@ def traverse(models: DeviceModels, block_part: jax.Array, tips: TipState,
         v, inc = newview_wave(models, block_part, xl, xr,
                               zl, zr, scale_exp, site_rates)
         sc = sl + sr + inc                                  # [W, B, lane]
-        clv = clv.at[parent].set(v, unique_indices=False)
+        clv = clv.at[parent].set(v.astype(clv.dtype),
+                                 unique_indices=False)
         scaler = scaler.at[parent].set(sc, unique_indices=False)
         return (clv, scaler), None
 
@@ -288,7 +292,7 @@ def gather_child_pooled(tips: TipState, pool: jax.Array,
         tip_clv.shape[:-1] + (R, tip_clv.shape[-1]))
     row = jnp.clip(idx - ntips, 0, slot_read.shape[0] - 1)
     cells = slot_read[row]                           # [..., B]
-    inner_clv = pool[cells]                          # [..., B, lane, R, K]
+    inner_clv = pool[cells].astype(tips.table.dtype)  # [..., B, lane, R, K]
     sel = is_tip[..., None, None, None, None]
     x = jnp.where(sel, tip_clv, inner_clv)
     sc = jnp.where(is_tip[..., None, None], 0, scaler[row])
@@ -319,7 +323,8 @@ def traverse_pooled(models: DeviceModels, block_part: jax.Array,
                               zl, zr, scale_exp, site_rates)
         sc = sl + sr + inc                               # [W, B, lane]
         cells = slot_write[parent]                       # [W, B]
-        pool = pool.at[cells].set(v, unique_indices=False)
+        pool = pool.at[cells].set(v.astype(pool.dtype),
+                                  unique_indices=False)
         scaler = scaler.at[parent].set(sc, unique_indices=False)
         return (pool, scaler), None
 

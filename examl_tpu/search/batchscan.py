@@ -248,8 +248,9 @@ def scan_program(eng, n_chunks: int):
             u = kernels.apply_p(kernels.p_matrices(dm, zp), block_part,
                                 xs)
 
-        minlik, two_e, _ = kernels.scale_constants(clv.dtype, scale_exp)
-        acc = kernels._acc_dtype(clv.dtype)
+        cdt = tips.table.dtype        # compute dtype (arena may store bf16)
+        minlik, two_e, _ = kernels.scale_constants(cdt, scale_exp)
+        acc = kernels._acc_dtype(cdt)
         _, _, log_min = kernels.scale_constants(acc, scale_exp)
 
         def chunk(carry, args):
@@ -336,8 +337,9 @@ def thorough_program(eng, n_chunks: int):
         clv, scaler = kernels.traverse(dm, block_part, tips, clv, scaler,
                                        tv, scale_exp, ntips, None)
         xs, ss = kernels.gather_child(tips, clv, scaler, sg, ntips)
-        minlik, two_e, _ = kernels.scale_constants(clv.dtype, scale_exp)
-        acc = kernels._acc_dtype(clv.dtype)
+        cdt = tips.table.dtype        # compute dtype (arena may store bf16)
+        minlik, two_e, _ = kernels.scale_constants(cdt, scale_exp)
+        acc = kernels._acc_dtype(cdt)
         _, _, log_min = kernels.scale_constants(acc, scale_exp)
 
         def papply(z, x):
@@ -348,7 +350,7 @@ def thorough_program(eng, n_chunks: int):
             st = kernels.sumtable(dm, block_part, xp, xq)
             return kernels.newton_raphson_branch(
                 dm, block_part, weights, st,
-                jnp.full(1, z0, dtype=clv.dtype),
+                jnp.full(1, z0, dtype=cdt),
                 jnp.full(1, iters, jnp.int32), jnp.zeros(1, bool), 1)[0]
 
         def one(xq1, sq1, xr1, sr1, z01):

@@ -106,3 +106,34 @@ def test_bf16x3_child_dot_bound():
     mixed = float(np.sum(eng.evaluate(root.number, root.back.number,
                                       root.z)))
     assert abs(mixed - exact) < 0.01, (mixed, exact)
+
+
+def test_bf16_clv_storage_bound(monkeypatch):
+    """EXAML_CLV_DTYPE=bf16 (ROOFLINE.md lever 3: the arena stores bf16,
+    compute stays f32 — halves HBM bytes/update) keeps the testData/49
+    lnL within the measured 1.7-absolute bound (8.5e-5 relative), on
+    both the fast chunk path and the scan path."""
+    import jax.numpy as jnp
+
+    from examl_tpu.instance import default_instance
+    from tests.conftest import TESTDATA
+
+    def build(env):
+        if env:
+            monkeypatch.setenv("EXAML_CLV_DTYPE", env)
+        else:
+            monkeypatch.delenv("EXAML_CLV_DTYPE", raising=False)
+        inst = default_instance(f"{TESTDATA}/49", f"{TESTDATA}/49.model",
+                                dtype=jnp.float32)
+        tree = inst.tree_from_newick(open(f"{TESTDATA}/49.tree").read())
+        full = float(inst.evaluate(tree, full=True))
+        partial = float(inst.evaluate(tree, tree.nodep[tree.ntips + 5]))
+        return inst, full, partial
+
+    _, f32_full, f32_part = build("")
+    inst, bf_full, bf_part = build("bf16")
+    (eng,) = inst.engines.values()
+    assert eng.clv.dtype == jnp.bfloat16
+    assert not eng.use_pallas          # Pallas tier requires f32 storage
+    assert abs(bf_full - f32_full) < 4.0, (bf_full, f32_full)
+    assert abs(bf_part - f32_part) < 4.0, (bf_part, f32_part)

@@ -34,9 +34,9 @@ def timed(fn, *args):
     return time.perf_counter() - t0
 
 
-def report(name, dt, entries, patterns, rates, states):
-    ups = N_STEPS * entries * patterns * rates * states / dt
-    print(f"{name:42s} {dt/N_STEPS*1e3:8.3f} ms/trav  {ups/1e9:8.2f} Gup/s"
+def report(name, dt, entries, patterns, rates, states, n_steps=N_STEPS):
+    ups = n_steps * entries * patterns * rates * states / dt
+    print(f"{name:42s} {dt/n_steps*1e3:8.3f} ms/trav  {ups/1e9:8.2f} Gup/s"
           f"  vs_avx={ups/2.552e9:6.2f}")
 
 
@@ -207,8 +207,35 @@ if __name__ == "__main__":
         blockdiag_variants()
 
 
-def variant_matrix():
-    """H: the full traversal-variant x precision matrix on the live chip.
+def _matrix_setup(large: bool):
+    """Shared instance/schedule/chain sizing for the matrix experiments.
+    Always f32 compute regardless of the tool's x64 default: the Pallas
+    and bf16 tiers require it and the chip measurement must match
+    bench.py's dtype."""
+    if large:
+        import os
+        import sys as _sys
+        _sys.path.insert(0, os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        from bench import LARGE_CONFIGS, _synthetic_instance
+        inst, tree = _synthetic_instance(*LARGE_CONFIGS["dna-large"],
+                                         dtype=jnp.float32)
+        eng = next(iter(inst.engines.values()))
+    else:
+        inst = default_instance(f"{DATA}/140", f"{DATA}/140.model",
+                                dtype=jnp.float32)
+        tree = inst.tree_from_newick(open(f"{DATA}/140.tree").read())
+        eng = inst.engines[20]
+    _, entries = tree.full_traversal_centroid()
+    patterns = sum(p.width for p in inst.alignment.partitions)
+    per_trav = len(entries) * patterns * eng.R * eng.K
+    n_steps = max(5, min(N_STEPS, int(2e9 / max(per_trav, 1))))
+    return inst, tree, eng, entries, patterns, n_steps
+
+
+def variant_matrix(large: bool = False):
+    """H: the full traversal-variant x precision matrix on the live chip
+    (L: same matrix on the compute-bound 0.5M-pattern synthetic config).
 
     Run first when the TPU returns: measures the chunked XLA fast path,
     the per-chunk Pallas kernels, and the whole-traversal kernel, each
@@ -217,14 +244,10 @@ def variant_matrix():
     """
     from examl_tpu.ops import pallas_whole
 
-    inst = default_instance(f"{DATA}/140", f"{DATA}/140.model")
-    tree = inst.tree_from_newick(open(f"{DATA}/140.tree").read())
-    eng = inst.engines[20]
-    _, entries = tree.full_traversal_centroid()
-    patterns = sum(p.width for p in inst.alignment.partitions)
+    inst, tree, eng, entries, patterns, n_steps = _matrix_setup(large)
     E, R, K = len(entries), eng.R, eng.K
     rep = functools.partial(report, entries=E, patterns=patterns,
-                            rates=R, states=K)
+                            rates=R, states=K, n_steps=n_steps)
     fsched = eng._fast_schedule(entries)
     wsched = pallas_whole.build_flat(entries, eng.ntips,
                                      eng.num_branch_slots)
@@ -234,10 +257,11 @@ def variant_matrix():
         def fn(clv, scaler):
             def body(_, cs):
                 return step(cs[0], cs[1])
-            c, s = jax.lax.fori_loop(0, N_STEPS, body, (clv, scaler))
+            c, s = jax.lax.fori_loop(0, n_steps, body, (clv, scaler))
             return jnp.sum(s)
         return fn
 
+    tag = "L" if large else "H"
     for prec, ptag in ((jax.lax.Precision.HIGHEST, "HIGHEST"),
                        (jax.lax.Precision.HIGH, "HIGH")):
         eng.fast_precision = prec
@@ -253,12 +277,45 @@ def variant_matrix():
                         eng.run_chunks_traced(c, s, fsched.chunks))
             try:
                 f = chained(step)
-                rep(f"H {name} {ptag}", timed(f, eng.clv, eng.scaler))
+                rep(f"{tag} {name} {ptag}", timed(f, eng.clv, eng.scaler))
             except Exception as exc:            # noqa: BLE001
-                print(f"H {name} {ptag}: FAILED {exc}")
+                print(f"{tag} {name} {ptag}: FAILED {exc}")
+
+
+def bf16_row(large: bool = False):
+    """B: the bf16 CLV-storage tier (EXAML_CLV_DTYPE=bf16) on the XLA
+    chunk path — ROOFLINE.md lever 3, expected ~2x on the bandwidth-
+    bound large config."""
+    import os
+    os.environ["EXAML_CLV_DTYPE"] = "bf16"
+    try:
+        inst, tree, eng, entries, patterns, n_steps = _matrix_setup(large)
+        E, R, K = len(entries), eng.R, eng.K
+        assert eng.clv.dtype == jnp.bfloat16, eng.clv.dtype
+        fsched = eng._fast_schedule(entries)
+
+        @jax.jit
+        def fn(clv, scaler):
+            def body(_, cs):
+                return eng.run_chunks_traced(cs[0], cs[1], fsched.chunks)
+            c, s = jax.lax.fori_loop(0, n_steps, body, (clv, scaler))
+            return jnp.sum(s)
+
+        tag = "L" if large else "H"
+        report(f"{tag} xla-chunks bf16-storage",
+               timed(fn, eng.clv, eng.scaler), E, patterns, R, K,
+               n_steps=n_steps)
+    except Exception as exc:                    # noqa: BLE001
+        print(f"bf16 row: FAILED {exc}")
+    finally:
+        os.environ.pop("EXAML_CLV_DTYPE", None)
 
 
 if __name__ == "__main__":
     import sys
     if "-H" in sys.argv:
         variant_matrix()
+        bf16_row()
+    if "-L" in sys.argv:
+        variant_matrix(large=True)
+        bf16_row(large=True)
