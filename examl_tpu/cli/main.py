@@ -179,6 +179,35 @@ def write_model_params(path: str, inst) -> None:
             f.write("\n")
 
 
+def selective_read_decision(model: str, is_bytefile: bool,
+                            has_auto_aa: bool, nprocs: int):
+    """("slice" | "whole" | "error"), reason — the per-process data-
+    loading policy, pure so it is unit-testable without a process group:
+
+    * "slice": each process seeks only its site blocks (readMyData,
+      `byteFile.c:278-382`);
+    * "whole": every process reads the full file (single-process jobs;
+      AUTO-protein partitions, whose BIC/AICc sample sizes must be
+      global; non-byteFile inputs);
+    * "error": PSR in a multi-process job — its rate scan fetches
+      block-sharded per-site arrays to the host, impossible once shards
+      span other processes; refusing at startup beats burning the
+      model-opt prefix before a deep crash.
+    """
+    if nprocs <= 1:
+        return "whole", "single process"
+    if model == "PSR":
+        return "error", ("-m PSR does not support multi-process "
+                         "execution yet (per-site rate state is "
+                         "host-global); run single-process or use GAMMA")
+    if not is_bytefile:
+        return "whole", "input is not a byteFile"
+    if has_auto_aa:
+        return "whole", ("AUTO protein model selection needs global "
+                         "sample sizes")
+    return "slice", "selective byteFile read"
+
+
 def _is_bytefile(path: str) -> bool:
     from examl_tpu.io.bytefile import BYTEFILE_MAGIC
     import struct
@@ -457,40 +486,31 @@ def main(argv=None) -> int:
     with files.phase("startup (io + engines)"):
         sharding = select_sharding(args, args.save_memory, log=files.info)
         # Multi-process jobs read only their own site columns (the
-        # reference's readMyData) — unless the model needs host-global
-        # per-site state (PSR) or the input is not a byteFile.
+        # reference's readMyData) — policy in selective_read_decision.
         local_window = None
-        if sharding is not None and _is_bytefile(args.bytefile):
+        if sharding is not None:
             import jax
-            if jax.process_count() > 1:
-                if args.model == "PSR":
-                    # PSR's rate scan fetches block-sharded per-site
-                    # arrays to the host — impossible once shards span
-                    # other processes.  Refuse at startup rather than
-                    # burning the model-opt prefix before a deep crash.
-                    files.info(
-                        "ERROR: -m PSR does not support multi-process "
-                        "execution yet (per-site rate state is "
-                        "host-global); run single-process or use GAMMA")
-                    return 1
+            nprocs = jax.process_count()
+            is_bf = _is_bytefile(args.bytefile)
+            has_auto = False
+            if nprocs > 1 and is_bf:
                 from examl_tpu.io.bytefile import (PROT_MODELS,
                                                    read_bytefile_meta)
                 meta = read_bytefile_meta(args.bytefile)
-                if any(PROT_MODELS[pm.prot] == "AUTO"
-                       for pm in meta.parts if pm.dtype_i == 2):
-                    # AUTO selection scores BIC/AICc with the weight-sum
-                    # sample size; slice-local sums would let processes
-                    # pick DIFFERENT matrices (diverging SPMD programs).
-                    files.info("AUTO protein partitions keep whole-file "
-                               "reads per process (model selection "
-                               "needs global sample sizes)")
-                else:
-                    local_window = (jax.process_index(),
-                                    jax.process_count())
-                    files.info(
-                        f"selective byteFile read: process "
-                        f"{local_window[0]} of {local_window[1]} loads "
-                        f"only its site blocks")
+                has_auto = any(PROT_MODELS[pm.prot] == "AUTO"
+                               for pm in meta.parts if pm.dtype_i == 2)
+            policy, reason = selective_read_decision(
+                args.model, is_bf, has_auto, nprocs)
+            if policy == "error":
+                files.info("ERROR: " + reason)
+                return 1
+            if policy == "slice":
+                local_window = (jax.process_index(), nprocs)
+                files.info(
+                    f"{reason}: process {local_window[0]} of "
+                    f"{local_window[1]} loads only its site blocks")
+            elif nprocs > 1:
+                files.info(f"whole-file reads per process ({reason})")
         data = _load_alignment(
             args.bytefile, local_window=local_window,
             block_multiple=(sharding.num_devices if sharding else 1))

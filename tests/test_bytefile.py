@@ -164,3 +164,21 @@ def test_read_reference_parser_output(data49, tree49_text):
     t2 = i2.tree_from_newick(tree49_text)
     assert i1.evaluate(t1, full=True) == pytest.approx(
         i2.evaluate(t2, full=True), abs=0.01)
+
+
+def test_slice_validation_errors(tmp_path_factory, data49):
+    from examl_tpu.io.bytefile import (read_bytefile_for_process,
+                                       read_bytefile_slice)
+    path = str(tmp_path_factory.mktemp("bf") / "t49.binary")
+    write_bytefile(path, data49)
+    with pytest.raises(ValueError, match="outside"):
+        read_bytefile_slice(path, {0: (0, 10 ** 9)})
+    with pytest.raises(ValueError, match="procid"):
+        read_bytefile_for_process(path, 5, 4)
+    # slice metadata: global width/offset recorded, weight sums global
+    sl = read_bytefile_for_process(path, 1, 4)
+    full = read_bytefile(path)
+    for sp, fp in zip(sl.partitions, full.partitions):
+        assert sp.global_weight_sum == int(fp.weights.sum())
+        if sp.width != fp.width:
+            assert sp.global_width == fp.width

@@ -116,3 +116,14 @@ def test_driver_search_per_partition_branches(tmp_path):
     assert t1 != t2, "per-partition branch lengths did not differ"
     info = (tmp_path / "ExaML_info.PM").read_text()
     assert "Wall-clock by phase" in info
+
+
+def test_selective_read_decision_table():
+    """Data-loading policy (readMyData analogue): pure decision table."""
+    from examl_tpu.cli.main import selective_read_decision as d
+    assert d("GAMMA", True, False, 1)[0] == "whole"     # single process
+    assert d("GAMMA", True, False, 4)[0] == "slice"
+    assert d("GAMMA", False, False, 4)[0] == "whole"    # raw PHYLIP
+    assert d("GAMMA", True, True, 4)[0] == "whole"      # AUTO protein
+    assert d("PSR", True, False, 4)[0] == "error"       # refused upfront
+    assert d("PSR", True, False, 1)[0] == "whole"       # single-proc PSR ok

@@ -207,11 +207,29 @@ if __name__ == "__main__":
         blockdiag_variants()
 
 
-def _matrix_setup(large: bool):
+def _matrix_setup(large: bool, clv_dtype: str = ""):
     """Shared instance/schedule/chain sizing for the matrix experiments.
-    Always f32 compute regardless of the tool's x64 default: the Pallas
-    and bf16 tiers require it and the chip measurement must match
-    bench.py's dtype."""
+    Always f32 compute, and EXPLICIT storage: the engine is built under
+    exactly `clv_dtype` ("" = f32 baseline) regardless of any inherited
+    EXAML_CLV_DTYPE — an operator export must not silently turn the
+    baseline rows into bf16 measurements.  The operator's env value is
+    restored afterwards."""
+    import os
+    prior = os.environ.get("EXAML_CLV_DTYPE")
+    if clv_dtype:
+        os.environ["EXAML_CLV_DTYPE"] = clv_dtype
+    else:
+        os.environ.pop("EXAML_CLV_DTYPE", None)
+    try:
+        return _matrix_setup_inner(large)
+    finally:
+        if prior is None:
+            os.environ.pop("EXAML_CLV_DTYPE", None)
+        else:
+            os.environ["EXAML_CLV_DTYPE"] = prior
+
+
+def _matrix_setup_inner(large: bool):
     if large:
         import os
         import sys as _sys
@@ -286,10 +304,9 @@ def bf16_row(large: bool = False):
     """B: the bf16 CLV-storage tier (EXAML_CLV_DTYPE=bf16) on the XLA
     chunk path — ROOFLINE.md lever 3, expected ~2x on the bandwidth-
     bound large config."""
-    import os
-    os.environ["EXAML_CLV_DTYPE"] = "bf16"
     try:
-        inst, tree, eng, entries, patterns, n_steps = _matrix_setup(large)
+        inst, tree, eng, entries, patterns, n_steps = _matrix_setup(
+            large, clv_dtype="bf16")
         E, R, K = len(entries), eng.R, eng.K
         assert eng.clv.dtype == jnp.bfloat16, eng.clv.dtype
         fsched = eng._fast_schedule(entries)
@@ -307,8 +324,6 @@ def bf16_row(large: bool = False):
                n_steps=n_steps)
     except Exception as exc:                    # noqa: BLE001
         print(f"bf16 row: FAILED {exc}")
-    finally:
-        os.environ.pop("EXAML_CLV_DTYPE", None)
 
 
 if __name__ == "__main__":
