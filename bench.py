@@ -423,25 +423,46 @@ def main() -> None:
     # vs_baseline has headroom to mean something.  Accelerator runs only
     # (a CPU host would swap on the 4-7 GB arenas), inside the budget.
     large = {}
-    large_cfg = os.environ.get("EXAML_BENCH_LARGE", "dna-large")
-    if (backend in ("tpu", "axon") and large_cfg in LARGE_CONFIGS
-            and _elapsed() < budget):
+    cfg_env = os.environ.get("EXAML_BENCH_LARGE", "dna-large,aa-large")
+    configs = []
+    for tok in (c.strip() for c in cfg_env.split(",") if c.strip()):
+        if tok in LARGE_CONFIGS:
+            configs.append(tok)
+        else:
+            sys.stderr.write(f"bench: unknown EXAML_BENCH_LARGE config "
+                             f"{tok!r} (known: "
+                             f"{','.join(LARGE_CONFIGS)}); skipping\n")
+    for i, large_cfg in enumerate(configs):
+        # first config keyed "large_*" (schema continuity), later ones
+        # prefixed by their name
+        pre = "large" if i == 0 else large_cfg.replace("-", "_")
+        if not (backend in ("tpu", "axon") and _elapsed() < budget):
+            continue
+        linst = ltree = None
         try:
             ntaxa, width, dtname = LARGE_CONFIGS[large_cfg]
             linst, ltree = _synthetic_instance(ntaxa, width, dtname)
             lm = _measure_traversal(linst, ltree, budget)
-            large = {"large_config": large_cfg,
-                     "large_updates_per_sec": round(lm["ups"], 1),
-                     "large_vs_baseline": round(lm["ups"] / avx, 3),
-                     "large_ms_per_traversal":
-                         round(lm["dt"] / lm["n_steps"] * 1000, 3),
-                     "large_variant": lm["variant"],
-                     "large_tflops_per_sec": lm["tflops_per_sec"],
-                     "large_mfu": lm["mfu"]}
+            large.update({
+                f"{pre}_config": large_cfg,
+                f"{pre}_updates_per_sec": round(lm["ups"], 1),
+                f"{pre}_vs_baseline": round(lm["ups"] / avx, 3),
+                f"{pre}_ms_per_traversal":
+                    round(lm["dt"] / lm["n_steps"] * 1000, 3),
+                f"{pre}_variant": lm["variant"],
+                f"{pre}_tflops_per_sec": lm["tflops_per_sec"],
+                f"{pre}_mfu": lm["mfu"]})
+            del lm
         except Exception as exc:                 # noqa: BLE001
             sys.stderr.write(f"bench: large config {large_cfg} failed: "
                              f"{exc}\n")
-            large = {"large_config": large_cfg, "large_error": str(exc)}
+            large[f"{pre}_config"] = large_cfg
+            large[f"{pre}_error"] = str(exc)
+        finally:
+            # Free the multi-GB arena before the next config — on the
+            # FAILURE path too (an OOM on config 1 must not cascade into
+            # config 2 by keeping the dead arena referenced).
+            del linst, ltree
     # A fallback run is NEVER comparable to an accelerator number: the
     # baseline is one AVX socket and the metric races the chip against
     # it, so vs_baseline only "counts" when the run executed on tpu/axon

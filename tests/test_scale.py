@@ -100,7 +100,9 @@ def test_host_paths_50k_taxa_within_budget():
     SURVEY §6) stays interactive: random-addition build is O(n) via the
     incremental branch list, and one full-tree fast-path schedule builds
     in about half a second (measured 0.52-0.61 s warm; generous bounds
-    absorb CI host contention)."""
+    absorb CI host contention).  Spot-measured at 100k taxa (one-off,
+    2026-07): build 2.4 s, traversal 0.29 s, to_newick 1.67 s,
+    from_newick 3.43 s, schedule 0.94 s — all linear in n."""
     import time
 
     import jax.numpy as jnp
