@@ -491,7 +491,13 @@ def rearrange_batched(inst: PhyloInstance, tree: Tree, ctx: SprContext,
                     ctx.lh_dec += 1
         hookup(prune.next, p1, p1z)
         hookup(prune.next.next, p2, p2z)
-        inst.new_view(tree, prune)
+        # No eager new_view(prune): the x-flag machinery is self-healing
+        # — the NEXT device program (the second endpoint's plan, or the
+        # next pruned node's makenewz) folds prune's stale orientation
+        # into its own traversal entries (compute_traversal resolves
+        # staleness), saving one of the three dispatches per scanned
+        # endpoint.  The sequential arm keeps the reference's eager
+        # newviewGeneric structure.
 
     q = p.back
     if not tree.is_tip(p.number):

@@ -227,3 +227,22 @@ def test_batched_scan_matches_sequential_psr():
     batched = batchscan.run_plan(inst, tree, plan)
     sequential = _sequential_scores(inst, tree, ctx, p, plan)
     np.testing.assert_allclose(batched, sequential, rtol=1e-9, atol=1e-6)
+
+
+def test_deferred_restore_keeps_clvs_consistent():
+    """The batched scan defers the post-restore new_view (saving one of
+    three dispatches per scanned endpoint, x-flags self-heal).  Guard:
+    IMMEDIATELY after rearrange_batched restores the pruned node — before
+    any full-traversal invalidation — an incremental partial evaluate
+    (which trusts the x-flags and stored CLVs) must agree with a clean
+    full recompute; stale CLVs would diverge here."""
+    inst = _instance(ntaxa=14, nsites=500, seed=9)
+    tree = inst.random_tree(9)
+    inst.evaluate(tree, full=True)
+    ctx = spr.SprContext(inst, thorough=False, do_cutoff=False)
+    c = tree.centroid_branch()
+    p = c if not tree.is_tip(c.number) else c.back
+    assert spr.rearrange_batched(inst, tree, ctx, p, 1, 5)
+    lpart = float(inst.evaluate(tree, p))          # incremental FIRST
+    lfull = float(inst.evaluate(tree, full=True))  # then clean recompute
+    assert abs(lpart - lfull) < 5e-4, (lpart, lfull)
