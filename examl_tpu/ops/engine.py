@@ -113,6 +113,14 @@ class LikelihoodEngine:
         # traversals update rows in place through the map.  The arena keeps
         # `fast_slack` rows of headroom for the fast path's padded writes.
         self.n_inner = max(ntips - 2, 1)
+        # EXAML_FAST_TRAVERSAL=0 forces the wave-batched scan tier for
+        # full traversals too (escape hatch: the chunk pipeline is the
+        # faster program, but the scan program is the one whose compile
+        # is proven on every backend; see bench.py stage isolation).
+        # Runtime-togglable via `force_scan` (the arena keeps its slack).
+        import os as _fos
+        self.force_scan = _fos.environ.get("EXAML_FAST_TRAVERSAL",
+                                           "") == "0"
         self.fast_slack = (0 if psr or save_memory
                            else min(64, _next_pow2(ntips)))
         self.num_rows = self.n_inner + self.fast_slack + 1
@@ -478,7 +486,8 @@ class LikelihoodEngine:
         """The fast path relayouts the whole arena, so it requires a
         traversal covering every inner node (full=True callers after
         invalidate_all) and the GAMMA kernels (PSR keeps the scan path)."""
-        return (not self.psr and self.fast_slack > 0
+        return (not self.psr and not self.force_scan
+                and self.fast_slack > 0
                 and len(entries) == self.n_inner)
 
     def _fast_schedule(self, entries: List[TraversalEntry]):
@@ -494,6 +503,20 @@ class LikelihoodEngine:
         for num, row in sched.row_of.items():
             self.row_map[num] = row
 
+    @property
+    def pallas_precision(self):
+        """Precision handed to the Pallas tiers: Mosaic lowers only
+        DEFAULT and HIGHEST ("Unsupported dot precision: HIGH" on real
+        v5e hardware), so the engine's HIGH default — a 3-pass-bf16
+        XLA-path optimization — maps to HIGHEST inside kernels, where
+        operands already sit in VMEM and extra passes cost no HBM.
+        Harnesses that pass an explicit HIGH to the pallas modules still
+        fail loudly (perf_lab precision sweeps must not mislabel rows)."""
+        import jax as _jax
+        if self.fast_precision == _jax.lax.Precision.HIGH:
+            return _jax.lax.Precision.HIGHEST
+        return self.fast_precision
+
     def _run_chunks_impl(self, dm, block_part, tips, clv, scaler, chunks):
         """Chunk execution on the engine-selected backend path (Pallas on
         TPU, plain XLA elsewhere); the ONE dispatch point shared by the
@@ -502,7 +525,7 @@ class LikelihoodEngine:
             from examl_tpu.ops import pallas_newview
             return pallas_newview.run_chunks(
                 dm, block_part, tips, clv, scaler, chunks,
-                self.scale_exp, precision=self.fast_precision,
+                self.scale_exp, precision=self.pallas_precision,
                 interpret=self.pallas_interpret)
         from examl_tpu.ops import fastpath
         return fastpath.run_chunks(dm, block_part, tips, clv, scaler,
@@ -527,7 +550,7 @@ class LikelihoodEngine:
         def run(clv, scaler, meta, lc, rc, zl, zr, dm, bp, tips):
             return pallas_whole.run_flat_arrays(
                 dm, bp, tips, clv, scaler, E, meta, lc, rc, zl, zr,
-                self.scale_exp, self.fast_precision,
+                self.scale_exp, self.pallas_precision,
                 self.pallas_interpret)
 
         def impl_eval(clv, scaler, meta, lc, rc, zl, zr, p_idx, q_idx,
@@ -580,7 +603,7 @@ class LikelihoodEngine:
         from examl_tpu.ops import pallas_whole
         return pallas_whole.run_flat(
             self.models, self.block_part, self.tips, clv, scaler, sched,
-            self.scale_exp, self.fast_precision, self.pallas_interpret)
+            self.scale_exp, self.pallas_precision, self.pallas_interpret)
 
     # -- batched SPR radius scan (search/batchscan.py) ----------------------
 

@@ -194,6 +194,12 @@ def run_chunks(models, block_part, tips, clv, scaler, chunks,
     """
     if precision is None:
         precision = HIGHEST
+    # NOTE: Mosaic rejects HIGH ("Unsupported dot precision: HIGH" on
+    # v5e); only DEFAULT and HIGHEST lower.  An explicit HIGH is passed
+    # through so harnesses sweeping precisions fail loudly rather than
+    # silently measuring a duplicate HIGHEST row; the engine maps its
+    # HIGH default to HIGHEST before dispatching here (engine.py
+    # `pallas_precision`).
     rows, B, lane, R, K = clv.shape
     RK = R * K
     C = tips.table.shape[0]

@@ -201,6 +201,9 @@ def run_flat_arrays(models, block_part, tips, clv, scaler, E: int,
     """Traceable form: schedule as arrays (meta is the scalar-prefetch
     operand; E is static)."""
     if precision is None:
+        # Explicit HIGH passes through and fails in Mosaic lowering —
+        # see pallas_newview.run_chunks_pallas; the engine maps HIGH to
+        # HIGHEST for the Pallas tiers (engine.py `pallas_precision`).
         precision = jax.lax.Precision.HIGHEST
     rows, B, L, R, K = clv.shape
     RK = R * K

@@ -246,3 +246,37 @@ def test_deferred_restore_keeps_clvs_consistent():
     lpart = float(inst.evaluate(tree, p))          # incremental FIRST
     lfull = float(inst.evaluate(tree, full=True))  # then clean recompute
     assert abs(lpart - lfull) < 5e-4, (lpart, lfull)
+
+
+def test_rearrange_batched_scores_match_sequential():
+    """Full `rearrange` equivalence across BOTH endpoints: the batched
+    arm defers the post-restore new_view after the first endpoint's scan
+    (spr.py scan_one), relying on compute_traversal folding the pruned
+    node's stale orientation into the SECOND endpoint's plan.  A wrong
+    fold would corrupt the second endpoint's candidate scores — the tree
+    would still be consistent (the CLV guard above passes) but the
+    search would pick a different move.  So compare the ctx outcome
+    (best_of_node / end_lh / chosen insertion slot) of rearrange vs
+    rearrange_batched per pruned node, with cutoff off (identical
+    candidate windows)."""
+    from examl_tpu.constants import UNLIKELY
+
+    inst = _instance(ntaxa=16, nsites=400, seed=3)
+    tree = inst.random_tree(3)
+    inst.evaluate(tree, full=True)
+
+    prunable = [tree.nodep[num] for num in tree.inner_numbers()
+                if not tree.is_tip(tree.nodep[num].back.number)][:4]
+    assert prunable
+    for p in prunable:
+        seq = spr.SprContext(inst, thorough=False, do_cutoff=False)
+        seq.best_of_node = UNLIKELY
+        bat = spr.SprContext(inst, thorough=False, do_cutoff=False)
+        bat.best_of_node = UNLIKELY
+        if not spr.rearrange(inst, tree, seq, p, 1, 5):
+            continue
+        assert spr.rearrange_batched(inst, tree, bat, p, 1, 5)
+        assert seq.best_of_node == pytest.approx(bat.best_of_node,
+                                                 abs=1e-6)
+        assert seq.end_lh == pytest.approx(bat.end_lh, abs=1e-6)
+        assert seq.insert_node is bat.insert_node, p.number

@@ -14,9 +14,9 @@ echo "== probe =="
 timeout 180 python -c "import jax; print(jax.devices()); import jax.numpy as j; print((j.ones((256,256))@j.ones((256,256))).block_until_ready().sum())" \
   || { echo "chip unreachable; aborting"; exit 1; }
 echo "== perf_lab -H (testData/140 matrix) ==" | tee "$OUT/perf_lab_H.log"
-PYTHONPATH="$REPO" timeout 1200 python tools/perf_lab.py -H 2>&1 | tee -a "$OUT/perf_lab_H.log"
+PYTHONPATH="$REPO${PYTHONPATH:+:$PYTHONPATH}" timeout 1200 python tools/perf_lab.py -H 2>&1 | tee -a "$OUT/perf_lab_H.log"
 echo "== perf_lab -L (0.5M-pattern matrix) ==" | tee "$OUT/perf_lab_L.log"
-PYTHONPATH="$REPO" timeout 1800 python tools/perf_lab.py -L 2>&1 | tee -a "$OUT/perf_lab_L.log"
+PYTHONPATH="$REPO${PYTHONPATH:+:$PYTHONPATH}" timeout 1800 python tools/perf_lab.py -L 2>&1 | tee -a "$OUT/perf_lab_L.log"
 echo "== bench.py =="
 EXAML_BENCH_BUDGET_S=900 timeout 1500 python bench.py 2> "$OUT/bench.err" | tee "$OUT/bench.json"
 echo "done: $OUT"

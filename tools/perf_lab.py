@@ -286,6 +286,14 @@ def variant_matrix(large: bool = False):
         for name, use_pallas, whole in (("xla-chunks", False, False),
                                         ("pallas-chunks", True, False),
                                         ("pallas-whole", True, True)):
+            if use_pallas and prec == jax.lax.Precision.HIGH:
+                # The engine maps HIGH -> HIGHEST for Pallas dispatch
+                # (Mosaic lowers only DEFAULT/HIGHEST), so this cell
+                # would silently duplicate the HIGHEST row — skip it
+                # rather than record a mislabeled number.
+                print(f"{tag} {name} {ptag}: SKIP (Mosaic has no HIGH; "
+                      "engine dispatches HIGHEST)")
+                continue
             eng.use_pallas = use_pallas
             if whole:
                 step = (lambda c, s:
