@@ -227,8 +227,15 @@ def _measure_variant(inst, tree, eng, entries, variant) -> dict:
     if variant in ("pallas", "whole") and jax.default_backend() not in (
             "tpu", "axon") and not eng.pallas_interpret:
         raise RuntimeError("Pallas tiers require the accelerator backend")
-    fn = _chained(_variant_step(eng, variant, entries), n_steps)
-    dt, compile_s, flops = _time_compiled(fn, eng.clv, eng.scaler)
+    # _variant_step flips eng.use_pallas at trace time; snapshot the
+    # engine's own tier decision so later stages (prims) measure the
+    # production path, not whichever variant was timed last.
+    tier = (eng.use_pallas, eng.pallas_whole)
+    try:
+        fn = _chained(_variant_step(eng, variant, entries), n_steps)
+        dt, compile_s, flops = _time_compiled(fn, eng.clv, eng.scaler)
+    finally:
+        eng.use_pallas, eng.pallas_whole = tier
     updates = n_steps * len(entries) * patterns * eng.R * eng.K
     try:
         peak = float(os.environ.get("EXAML_PEAK_FLOPS", "1.97e14"))

@@ -118,8 +118,8 @@ class LikelihoodEngine:
         # faster program, but the scan program is the one whose compile
         # is proven on every backend; see bench.py stage isolation).
         # Runtime-togglable via `force_scan` (the arena keeps its slack).
-        import os as _fos
-        self.force_scan = _fos.environ.get("EXAML_FAST_TRAVERSAL",
+        import os as _pos
+        self.force_scan = _pos.environ.get("EXAML_FAST_TRAVERSAL",
                                            "") == "0"
         self.fast_slack = (0 if psr or save_memory
                            else min(64, _next_pow2(ntips)))
@@ -136,7 +136,6 @@ class LikelihoodEngine:
         # evaluation stay at HIGHEST (cancellation-prone -- the measurement
         # that rejected HIGH globally was dominated by those).  CPU ignores
         # the knob (always true f32/f64).  EXAML_DOT_PRECISION overrides.
-        import os as _pos
         # CLV STORAGE dtype (ROOFLINE.md lever 3): the newview kernel is
         # HBM-bandwidth-bound, so storing the arena in bf16 (compute
         # stays f32: gathers upcast after the load, stores downcast
@@ -430,7 +429,7 @@ class LikelihoodEngine:
             return
         sched = self._fast_schedule(entries)
         fn = self._fast_fn(sched.profile, with_eval=False)
-        data = tuple((c.base, c.lidx, c.ridx, c.lcode, c.rcode,
+        data = tuple((c.lidx, c.ridx, c.lcode, c.rcode,
                       c.zl, c.zr) for c in sched.chunks)
         self.clv, self.scaler = fn(self.clv, self.scaler, data,
                                    self.models, self.block_part,
@@ -512,9 +511,8 @@ class LikelihoodEngine:
         operands already sit in VMEM and extra passes cost no HBM.
         Harnesses that pass an explicit HIGH to the pallas modules still
         fail loudly (perf_lab precision sweeps must not mislabel rows)."""
-        import jax as _jax
-        if self.fast_precision == _jax.lax.Precision.HIGH:
-            return _jax.lax.Precision.HIGHEST
+        if self.fast_precision == jax.lax.Precision.HIGH:
+            return jax.lax.Precision.HIGHEST
         return self.fast_precision
 
     def _run_chunks_impl(self, dm, block_part, tips, clv, scaler, chunks):
@@ -761,8 +759,9 @@ class LikelihoodEngine:
 
         def impl_eval(clv, scaler, chunk_data, p_idx, q_idx, z, dm,
                       block_part, weights, tips):
-            chunks = [fastpath.FastChunk(kind, width, *cd)
-                      for (kind, width), cd in zip(profile, chunk_data)]
+            chunks = [fastpath.FastChunk(kind, width, base, *cd)
+                      for (kind, width, base), cd in zip(profile,
+                                                         chunk_data)]
             clv, scaler = self._run_chunks_impl(dm, block_part, tips, clv,
                                                 scaler, chunks)
             lnl = kernels.root_log_likelihood(
@@ -771,8 +770,9 @@ class LikelihoodEngine:
             return clv, scaler, lnl
 
         def impl(clv, scaler, chunk_data, dm, block_part, tips):
-            chunks = [fastpath.FastChunk(kind, width, *cd)
-                      for (kind, width), cd in zip(profile, chunk_data)]
+            chunks = [fastpath.FastChunk(kind, width, base, *cd)
+                      for (kind, width, base), cd in zip(profile,
+                                                         chunk_data)]
             return self._run_chunks_impl(dm, block_part, tips, clv, scaler,
                                          chunks)
 
@@ -845,7 +845,7 @@ class LikelihoodEngine:
             return self._run_whole(entries, p_num, q_num, z)
         sched = self._fast_schedule(entries)
         fn = self._fast_fn(sched.profile, with_eval=True)
-        data = tuple((c.base, c.lidx, c.ridx, c.lcode, c.rcode,
+        data = tuple((c.lidx, c.ridx, c.lcode, c.rcode,
                       c.zl, c.zr) for c in sched.chunks)
 
         zv = jnp.asarray(_z_slots(z, self.num_branch_slots),
