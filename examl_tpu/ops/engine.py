@@ -118,8 +118,8 @@ class LikelihoodEngine:
         # faster program, but the scan program is the one whose compile
         # is proven on every backend; see bench.py stage isolation).
         # Runtime-togglable via `force_scan` (the arena keeps its slack).
-        import os as _pos
-        self.force_scan = _pos.environ.get("EXAML_FAST_TRAVERSAL",
+        import os as _fos
+        self.force_scan = _fos.environ.get("EXAML_FAST_TRAVERSAL",
                                            "") == "0"
         self.fast_slack = (0 if psr or save_memory
                            else min(64, _next_pow2(ntips)))
@@ -136,6 +136,7 @@ class LikelihoodEngine:
         # evaluation stay at HIGHEST (cancellation-prone -- the measurement
         # that rejected HIGH globally was dominated by those).  CPU ignores
         # the knob (always true f32/f64).  EXAML_DOT_PRECISION overrides.
+        import os as _pos
         # CLV STORAGE dtype (ROOFLINE.md lever 3): the newview kernel is
         # HBM-bandwidth-bound, so storing the arena in bf16 (compute
         # stays f32: gathers upcast after the load, stores downcast
@@ -159,7 +160,13 @@ class LikelihoodEngine:
                 f"EXAML_DOT_PRECISION={_prec!r}: expected one of "
                 "default/high/highest")
         self.fast_precision = getattr(jax.lax.Precision, _prec)
-        self._fast_jit_cache = {}
+        # LRU-bounded: topology churn during a search mints distinct
+        # wave profiles without bound; evicting beyond 32 keeps
+        # compiled-program memory bounded (recompiling a re-seen profile
+        # costs seconds, holding hundreds costs GBs).
+        from collections import OrderedDict
+        self._fast_jit_cache = OrderedDict()
+        self._fast_jit_cache_cap = 32
         self.sharding = sharding
         self.pallas_interpret = _pos.environ.get(
             "EXAML_PALLAS_INTERPRET", "") == "1"
@@ -429,7 +436,7 @@ class LikelihoodEngine:
             return
         sched = self._fast_schedule(entries)
         fn = self._fast_fn(sched.profile, with_eval=False)
-        data = tuple((c.lidx, c.ridx, c.lcode, c.rcode,
+        data = tuple((c.base, c.lidx, c.ridx, c.lcode, c.rcode,
                       c.zl, c.zr) for c in sched.chunks)
         self.clv, self.scaler = fn(self.clv, self.scaler, data,
                                    self.models, self.block_part,
@@ -542,6 +549,7 @@ class LikelihoodEngine:
         key = ("whole", E, with_eval)
         fn = self._fast_jit_cache.get(key)
         if fn is not None:
+            self._fast_jit_cache.move_to_end(key)
             return fn
         from examl_tpu.ops import pallas_whole
 
@@ -563,6 +571,8 @@ class LikelihoodEngine:
         fn = jax.jit(impl_eval if with_eval else run,
                      donate_argnums=(0, 1))
         self._fast_jit_cache[key] = fn
+        while len(self._fast_jit_cache) > self._fast_jit_cache_cap:
+            self._fast_jit_cache.popitem(last=False)
         return fn
 
     def _whole_args(self, entries):
@@ -754,14 +764,14 @@ class LikelihoodEngine:
         key = (profile, with_eval)
         fn = self._fast_jit_cache.get(key)
         if fn is not None:
+            self._fast_jit_cache.move_to_end(key)
             return fn
         from examl_tpu.ops import fastpath
 
         def impl_eval(clv, scaler, chunk_data, p_idx, q_idx, z, dm,
                       block_part, weights, tips):
-            chunks = [fastpath.FastChunk(kind, width, base, *cd)
-                      for (kind, width, base), cd in zip(profile,
-                                                         chunk_data)]
+            chunks = [fastpath.FastChunk(kind, width, *cd)
+                      for (kind, width), cd in zip(profile, chunk_data)]
             clv, scaler = self._run_chunks_impl(dm, block_part, tips, clv,
                                                 scaler, chunks)
             lnl = kernels.root_log_likelihood(
@@ -770,14 +780,15 @@ class LikelihoodEngine:
             return clv, scaler, lnl
 
         def impl(clv, scaler, chunk_data, dm, block_part, tips):
-            chunks = [fastpath.FastChunk(kind, width, base, *cd)
-                      for (kind, width, base), cd in zip(profile,
-                                                         chunk_data)]
+            chunks = [fastpath.FastChunk(kind, width, *cd)
+                      for (kind, width), cd in zip(profile, chunk_data)]
             return self._run_chunks_impl(dm, block_part, tips, clv, scaler,
                                          chunks)
 
         fn = jax.jit(impl_eval if with_eval else impl, donate_argnums=(0, 1))
         self._fast_jit_cache[key] = fn
+        while len(self._fast_jit_cache) > self._fast_jit_cache_cap:
+            self._fast_jit_cache.popitem(last=False)
         return fn
 
     # -- evaluation --------------------------------------------------------
@@ -845,7 +856,7 @@ class LikelihoodEngine:
             return self._run_whole(entries, p_num, q_num, z)
         sched = self._fast_schedule(entries)
         fn = self._fast_fn(sched.profile, with_eval=True)
-        data = tuple((c.lidx, c.ridx, c.lcode, c.rcode,
+        data = tuple((c.base, c.lidx, c.ridx, c.lcode, c.rcode,
                       c.zl, c.zr) for c in sched.chunks)
 
         zv = jnp.asarray(_z_slots(z, self.num_branch_slots),

@@ -46,13 +46,7 @@ class FastChunk(NamedTuple):
     """
     kind: int
     width: int
-    base: int               # STATIC first arena row written: bases are
-    #                         cumulative wave widths, fully determined by
-    #                         the (kind,width) profile, so keeping them
-    #                         static turns every arena write into a
-    #                         constant-offset update-slice XLA can fuse
-    #                         (and one compiled fast_fn still serves all
-    #                         schedules sharing a profile)
+    base: jax.Array         # scalar int32: first arena row written
     lidx: jax.Array         # [W] arena row of left child (kind 2)
     ridx: jax.Array         # [W] arena row of right child (kind 1, 2)
     lcode: jax.Array        # [W] 0-based tip index of left child (kind 0, 1)
@@ -139,24 +133,22 @@ def build_schedule(entries: List[TraversalEntry], ntips: int,
                     zl[wi] = z_slots(ezl, num_slots)
                     zr[wi] = z_slots(ezr, num_slots)
             host_chunks.append(
-                (kind, W, int(base + off), lidx, ridx, lcode, rcode,
+                (kind, W, np.int32(base + off), lidx, ridx, lcode, rcode,
                  np.asarray(zl, dtype), np.asarray(zr, dtype)))
             max_write = max(max_write, base + off + W)
             off += len(grp)
         rows = base + off
     # ONE batched host->device transfer for every chunk's arrays: at 50k
-    # taxa this is ~1,500 chunks x 6 arrays, and per-array jnp.asarray
+    # taxa this is ~1,500 chunks x 7 arrays, and per-array jnp.asarray
     # device_puts dominated the whole schedule build (~1.5 s of 2.3 s);
-    # the batched put is ~30 ms.  Bases stay HOST ints: they are part of
-    # the static profile (off advances by unpadded group sizes, so base
-    # is not recoverable from (kind,width) alone).
-    flat = [a for hc in host_chunks for a in hc[3:]]
+    # the batched put is ~30 ms.
+    flat = [a for hc in host_chunks for a in hc[2:]]
     dev = iter(jax.device_put(flat))
-    chunks = [FastChunk(kind=kind, width=W, base=b,
+    chunks = [FastChunk(kind=kind, width=W, base=next(dev),
                         lidx=next(dev), ridx=next(dev), lcode=next(dev),
                         rcode=next(dev), zl=next(dev), zr=next(dev))
-              for (kind, W, b, *_rest) in host_chunks]
-    profile = tuple((c.kind, c.width, c.base) for c in chunks)
+              for (kind, W, *_rest) in host_chunks]
+    profile = tuple((c.kind, c.width) for c in chunks)
     return FastSchedule(chunks=tuple(chunks), row_of=row_of,
                         profile=profile, num_rows=rows, max_write=max_write)
 
@@ -222,10 +214,9 @@ def run_chunks(models: kernels.DeviceModels, block_part: jax.Array,
         needs = jnp.max(jnp.abs(v), axis=3) < minlik
         v = jnp.where(needs[..., None], v * two_e, v)
         sc = sc + needs.astype(jnp.int32)
-        # ch.base is a static int: these lower to constant-offset
-        # update-slices instead of data-dependent scatters.
+        z0 = jnp.zeros((), ch.base.dtype)
         clv = jax.lax.dynamic_update_slice(
             clv, v.reshape(W, B, lane, R, K).astype(clv.dtype),
-            (ch.base, 0, 0, 0, 0))
-        scaler = jax.lax.dynamic_update_slice(scaler, sc, (ch.base, 0, 0))
+            (ch.base, z0, z0, z0, z0))
+        scaler = jax.lax.dynamic_update_slice(scaler, sc, (ch.base, z0, z0))
     return clv, scaler

@@ -227,8 +227,7 @@ def run_chunks(models, block_part, tips, clv, scaler, chunks,
         lcodes = tips.codes[ch.lcode].astype(jnp.int32)
         rcodes = tips.codes[ch.rcode].astype(jnp.int32)
         clvf, scaler = _run_chunk(
-            clvf, scaler, ch.lidx, ch.ridx,
-            jnp.full((1,), ch.base, jnp.int32), opl, opr,
+            clvf, scaler, ch.lidx, ch.ridx, ch.base[None], opl, opr,
             lcodes, rcodes, scsum, kind=ch.kind, W=W, C=C,
             scale_exp=scale_exp, precision=precision, interpret=interpret)
     return clvf.reshape(rows, B, lane, R, K), scaler

@@ -248,6 +248,7 @@ def test_deferred_restore_keeps_clvs_consistent():
     assert abs(lpart - lfull) < 5e-4, (lpart, lfull)
 
 
+@pytest.mark.slow
 def test_rearrange_batched_scores_match_sequential():
     """Full `rearrange` equivalence across BOTH endpoints: the batched
     arm defers the post-restore new_view after the first endpoint's scan

@@ -52,6 +52,7 @@ def test_rerun_determinism():
     assert a == b == c
 
 
+@pytest.mark.slow
 def test_bf16x3_child_dot_bound():
     """The fast path's default child-contraction precision (HIGH, 3-pass
     bf16) must stay inside the NUMERICS.md bound.  Emulated exactly as
@@ -108,6 +109,7 @@ def test_bf16x3_child_dot_bound():
     assert abs(mixed - exact) < 0.01, (mixed, exact)
 
 
+@pytest.mark.slow
 def test_bf16_clv_storage_bound(monkeypatch):
     """EXAML_CLV_DTYPE=bf16 (ROOFLINE.md lever 3: the arena stores bf16,
     compute stays f32 — halves HBM bytes/update) keeps the testData/49

@@ -28,6 +28,7 @@ def test_tree_evaluate_improves_and_converges(setup49):
     assert abs(lnl2 - lnl1) < 1e-4
 
 
+@pytest.mark.slow
 def test_mod_opt_improves_monotonically(setup49):
     inst, tree = setup49
     lnl0 = inst.evaluate(tree, full=True)

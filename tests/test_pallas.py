@@ -52,6 +52,7 @@ def _compare(inst, tree, z_override=None):
     return ref_sc
 
 
+@pytest.mark.slow
 def test_pallas_matches_fastpath_aa():
     inst = _instance("AA", 24, 300)
     _compare(inst, inst.random_tree(1))
@@ -62,6 +63,7 @@ def test_pallas_matches_fastpath_dna():
     _compare(inst, inst.random_tree(2))
 
 
+@pytest.mark.slow
 def test_pallas_scaling_events_match():
     """Short branches force rescale events; the int32 scaler rows must be
     identical (they feed the lnL correction term)."""

@@ -110,6 +110,7 @@ def test_random_quartet_sampling(tmp_path, inst8):
     assert len(lines) == 30
 
 
+@pytest.mark.slow
 def test_batched_scorer_matches_sequential(inst8):
     """quartets_batch.score_jobs reproduces the sequential NNI-smoothed
     topology lnLs (same smoothing passes, same Newton semantics)."""

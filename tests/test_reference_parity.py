@@ -56,6 +56,7 @@ def test_raw_evaluate_at_reference_optimum_49():
     assert lnl == pytest.approx(_fixture_lnl("ref49"), abs=2e-3)
 
 
+@pytest.mark.slow
 def test_raw_evaluate_at_reference_optimum_140():
     """Pure-likelihood parity on the 140-taxon AA set (WAG + AUTO
     partitions resolved to the reference's chosen matrices)."""

@@ -141,6 +141,7 @@ def test_sev_topology_change_reallocates(gappy):
     assert l2 == pytest.approx(l1, rel=1e-12, abs=1e-8)
 
 
+@pytest.mark.slow
 def test_sev_batched_scan_matches_dense(gappy):
     """The one-dispatch SPR radius scan on an SEV pool (scan region
     carved from the pool, engine.ensure_scan_rows) returns the same
