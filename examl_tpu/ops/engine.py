@@ -430,6 +430,44 @@ class LikelihoodEngine:
             self.tips, self.site_rates)
         self._set_buf(buf)
 
+    def _guard_first_call(self, fn):
+        """Wrap a freshly-jitted program so its FIRST invocation (= the
+        compile) runs under a watchdog: on the axon/TPU remote-compile
+        tunnel a pathological compile blocks in recv with no
+        Python-level recourse (observed round 4: the chunk program never
+        returned), so after 180 s a daemon thread tells the user which
+        escape hatch pins the hardware-proven scan tier.  Compile
+        happens in C++ with the GIL released, so the timer thread does
+        run while the main thread is stuck.  Installed at every
+        fast-program cache miss, so recompiles after a Mosaic-failure
+        fallback (or LRU eviction) are guarded too."""
+        state = {"first": True}
+
+        def call(*args):
+            if not state["first"]:
+                return fn(*args)
+            state["first"] = False
+            import threading
+
+            done = threading.Event()
+
+            def bark():
+                if not done.wait(180.0):
+                    import sys
+                    sys.stderr.write(
+                        "EXAML: a fast-traversal compile has taken >180s "
+                        "— if this never returns, rerun with "
+                        "EXAML_FAST_TRAVERSAL=0 (scan tier) or "
+                        "EXAML_PALLAS=0.\n")
+
+            threading.Thread(target=bark, daemon=True).start()
+            try:
+                return fn(*args)
+            finally:
+                done.set()
+
+        return call
+
     def _run_fast_traversal(self, entries: List[TraversalEntry]) -> None:
         if self.pallas_whole:
             self._run_whole(entries)
@@ -568,8 +606,9 @@ class LikelihoodEngine:
                 self.num_parts, self.scale_exp, self.ntips, None)
             return clv, scaler, lnl
 
-        fn = jax.jit(impl_eval if with_eval else run,
-                     donate_argnums=(0, 1))
+        fn = self._guard_first_call(
+            jax.jit(impl_eval if with_eval else run,
+                    donate_argnums=(0, 1)))
         self._fast_jit_cache[key] = fn
         while len(self._fast_jit_cache) > self._fast_jit_cache_cap:
             self._fast_jit_cache.popitem(last=False)
@@ -785,7 +824,8 @@ class LikelihoodEngine:
             return self._run_chunks_impl(dm, block_part, tips, clv, scaler,
                                          chunks)
 
-        fn = jax.jit(impl_eval if with_eval else impl, donate_argnums=(0, 1))
+        fn = self._guard_first_call(
+            jax.jit(impl_eval if with_eval else impl, donate_argnums=(0, 1)))
         self._fast_jit_cache[key] = fn
         while len(self._fast_jit_cache) > self._fast_jit_cache_cap:
             self._fast_jit_cache.popitem(last=False)
