@@ -180,7 +180,8 @@ def write_model_params(path: str, inst) -> None:
 
 
 def selective_read_decision(model: str, is_bytefile: bool,
-                            has_auto_aa: bool, nprocs: int):
+                            has_auto_aa: bool, nprocs: int,
+                            save_memory: bool = False):
     """("slice" | "whole" | "error"), reason — the per-process data-
     loading policy, pure so it is unit-testable without a process group:
 
@@ -205,6 +206,10 @@ def selective_read_decision(model: str, is_bytefile: bool,
     if has_auto_aa:
         return "whole", ("AUTO protein model selection needs global "
                          "sample sizes")
+    if save_memory:
+        return "whole", ("-S gap bookkeeping is host-global (SevState "
+                         "tip bitsets span all blocks); whole-file read "
+                         "per process")
     return "slice", "selective byteFile read"
 
 
@@ -500,7 +505,8 @@ def main(argv=None) -> int:
                 has_auto = any(PROT_MODELS[pm.prot] == "AUTO"
                                for pm in meta.parts if pm.dtype_i == 2)
             policy, reason = selective_read_decision(
-                args.model, is_bf, has_auto, nprocs)
+                args.model, is_bf, has_auto, nprocs,
+                save_memory=getattr(args, "save_memory", False))
             if policy == "error":
                 files.info("ERROR: " + reason)
                 return 1

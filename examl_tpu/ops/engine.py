@@ -94,9 +94,6 @@ class LikelihoodEngine:
         if save_memory and psr:
             raise ValueError("-S (SEV) is not supported under PSR "
                              "(the reference likewise restricts -S)")
-        if save_memory and sharding is not None:
-            raise ValueError("-S (SEV) pool indirection does not compose "
-                             "with site-axis sharding yet")
         self.dtype = jnp.dtype(dtype)
         self.scale_exp = (scale_exp if scale_exp is not None
                           else kernels.default_scale_exponent(self.dtype))
@@ -213,9 +210,37 @@ class LikelihoodEngine:
         if save_memory:
             from examl_tpu.ops.sev import SevState
             self.clv = None
+            ndev = sharding.num_devices if sharding is not None else 1
+            if sharding is not None:
+                from jax.sharding import NamedSharding
+                from jax.sharding import PartitionSpec as _P
+                from examl_tpu.parallel.sharding import SITE_AXIS as _SA
+                _pool_sh = NamedSharding(sharding.mesh, _P(_SA))
+                _slot_sh = NamedSharding(sharding.mesh, _P(None, _SA))
+
+                def zeros_pool(shape, dt):
+                    # Born sharded: -S exists because the pool only fits
+                    # when split across devices, so it must never stage
+                    # whole on one device (same invariant as
+                    # _zeros_sharded for the dense arena).
+                    npdt = np.dtype(dt)
+
+                    def shard_zeros(idx):
+                        return np.zeros(tuple(
+                            len(range(*sl.indices(dim)))
+                            for sl, dim in zip(idx, shape)), dtype=npdt)
+
+                    return jax.make_array_from_callback(
+                        tuple(shape), _pool_sh, shard_zeros)
+
+                put_slot = lambda x: jax.device_put(jnp.asarray(x),
+                                                    _slot_sh)
+            else:
+                zeros_pool = put_slot = None
             self.sev = SevState(bucket.tip_codes, self._undetermined_code(),
                                 self.num_rows, B, lane, self.R, self.K,
-                                self.storage_dtype)
+                                self.storage_dtype, ndev=ndev,
+                                zeros_pool=zeros_pool, put_slot=put_slot)
         else:
             self.sev = None
             self.clv = self._zeros_sharded(
@@ -247,15 +272,83 @@ class LikelihoodEngine:
         # CLV/scaler buffers are donated: they are replaced by the outputs,
         # never read again.  site_rates rides along as a traced argument
         # (None on the GAMMA path).
-        self._jit_traverse = jax.jit(self._traverse_only_impl,
-                                     donate_argnums=(0, 1))
-        self._jit_evaluate = jax.jit(self._evaluate_impl)
-        self._jit_trav_eval = jax.jit(self._trav_eval_impl,
-                                      donate_argnums=(0, 1))
-        self._jit_newton = jax.jit(self._newton_impl, donate_argnums=(0, 1))
-        self._jit_sumtable = jax.jit(self._sumtable_impl)
-        self._jit_derivs = jax.jit(self._derivs_impl)
+        from examl_tpu.parallel.sharding import SITE_AXIS as _SAX
+        self._axis_name = (_SAX if (save_memory and sharding is not None)
+                           else None)
+        if self._axis_name is not None:
+            self._build_sev_mapped_programs()
+        else:
+            self._jit_traverse = jax.jit(self._traverse_only_impl,
+                                         donate_argnums=(0, 1))
+            self._jit_evaluate = jax.jit(self._evaluate_impl)
+            self._jit_trav_eval = jax.jit(self._trav_eval_impl,
+                                          donate_argnums=(0, 1))
+            self._jit_newton = jax.jit(self._newton_impl,
+                                       donate_argnums=(0, 1))
+            self._jit_sumtable = jax.jit(self._sumtable_impl)
+            self._jit_derivs = jax.jit(self._derivs_impl)
         self._jit_rate_scan = jax.jit(self._rate_scan_impl)
+
+    def _build_sev_mapped_programs(self) -> None:
+        """SEV x sharding: the pooled programs run under `jax.shard_map`.
+
+        The pool's cell axis is irregular while the mesh shards blocks,
+        so GSPMD cannot prove the pool gathers local; shard_map makes
+        the guarantee structural: each device's program sees ITS pool
+        region [cap, lane, R, K] (cell ids are region-local,
+        ops/sev.py), its block range of the slot maps / tip codes /
+        weights, and runs the IDENTICAL pooled kernel — the only
+        cross-device traffic is the lnL / derivative psum the kernels
+        emit when axis_name is set (the reference's MPI Allreduces,
+        `evaluateGenericSpecial.c:968-973`,
+        `makenewzGenericSpecial.c:1241-1248`)."""
+        from jax.sharding import PartitionSpec as P
+
+        from examl_tpu.parallel.sharding import SITE_AXIS as AX
+
+        mesh = self.sharding.mesh
+        REP = P()
+        pool_s = P(AX)                       # [ndev*cap, lane, R, K]
+        sc_s = P(None, AX)                   # [rows, B, lane]
+        aux_s = (P(None, AX), P(None, AX))   # slot_read, slot_write
+        b_s = P(AX)                          # block_part [B]
+        bl_s = P(AX)                         # weights [B, lane]
+        tips_s = kernels.TipState(codes=P(None, AX), table=REP)
+        dm_s = DeviceModels(*(REP,) * len(DeviceModels._fields))
+        tv_s = Traversal(*(REP,) * len(Traversal._fields))
+
+        def wrap(impl, in_specs, out_specs, donate=()):
+            mapped = jax.shard_map(impl, mesh=mesh, in_specs=in_specs,
+                                   out_specs=out_specs)
+            return jax.jit(mapped, donate_argnums=donate)
+
+        self._jit_traverse = wrap(
+            self._traverse_only_impl,
+            (pool_s, sc_s, aux_s, tv_s, dm_s, b_s, tips_s, None),
+            (pool_s, sc_s), donate=(0, 1))
+        self._jit_evaluate = wrap(
+            self._evaluate_impl,
+            (pool_s, sc_s, aux_s, REP, REP, REP, dm_s, b_s, bl_s,
+             tips_s, None),
+            REP)
+        self._jit_trav_eval = wrap(
+            self._trav_eval_impl,
+            (pool_s, sc_s, aux_s, tv_s, REP, REP, REP, dm_s, b_s, bl_s,
+             tips_s, None),
+            (pool_s, sc_s, REP), donate=(0, 1))
+        self._jit_newton = wrap(
+            self._newton_impl,
+            (pool_s, sc_s, aux_s, tv_s, REP, REP, REP, REP, REP, dm_s,
+             b_s, bl_s, tips_s, None),
+            (pool_s, sc_s, REP), donate=(0, 1))
+        self._jit_sumtable = wrap(
+            self._sumtable_impl,
+            (pool_s, sc_s, aux_s, REP, REP, dm_s, b_s, tips_s),
+            P(AX))
+        self._jit_derivs = wrap(
+            self._derivs_impl,
+            (P(AX), REP, dm_s, b_s, bl_s, None),
+            (REP, REP))
 
     # -- construction helpers ---------------------------------------------
 
@@ -839,7 +932,7 @@ class LikelihoodEngine:
         xq, sq = self._gather(buf, aux, scaler, q_idx, tips)
         return kernels.root_log_likelihood_from(
             dm, block_part, weights, xp, sp, xq, sq, z, self.num_parts,
-            self.scale_exp, sr)
+            self.scale_exp, sr, axis_name=self._axis_name)
 
     def evaluate(self, p_num: int, q_num: int, z: Sequence[float]) -> np.ndarray:
         """Per-partition lnL [M] at branch (p,q); CLVs must be current."""
@@ -928,7 +1021,8 @@ class LikelihoodEngine:
         st = kernels.sumtable(dm, block_part, xp, xq)
         z = kernels.newton_raphson_branch(dm, block_part, weights, st, z0,
                                           maxiters, conv,
-                                          self.num_branch_slots, sr)
+                                          self.num_branch_slots, sr,
+                                          axis_name=self._axis_name)
         return buf, scaler, z
 
     def newton_branch(self, entries: List[TraversalEntry], p_num: int,
@@ -998,7 +1092,8 @@ class LikelihoodEngine:
 
     def _derivs_impl(self, st, z, dm, block_part, weights, sr):
         return kernels.nr_derivatives(dm, block_part, weights,
-                                      st, z, self.num_branch_slots, sr)
+                                      st, z, self.num_branch_slots, sr,
+                                      axis_name=self._axis_name)
 
     def make_sumtable(self, p_num: int, q_num: int) -> jax.Array:
         buf, aux = self._state()

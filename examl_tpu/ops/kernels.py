@@ -400,8 +400,15 @@ def root_log_likelihood(models: DeviceModels, block_part: jax.Array,
 def root_log_likelihood_from(models: DeviceModels, block_part: jax.Array,
                              weights: jax.Array, xp, sp, xq, sq,
                              z: jax.Array, num_parts: int, scale_exp: int,
-                             site_rates=None):
-    """root_log_likelihood over pre-gathered root CLVs (pooled/SEV path)."""
+                             site_rates=None, axis_name=None):
+    """root_log_likelihood over pre-gathered root CLVs (pooled/SEV path).
+
+    axis_name: set when tracing under shard_map (SEV x sharding) — the
+    segment sum then only covers the device-local blocks, so the
+    cross-device half of the reference's lnL Allreduce
+    (`evaluateGenericSpecial.c:968-973`) is an explicit psum here
+    (GSPMD inserts it automatically on the dense path; shard_map does
+    not)."""
     lsite = site_likelihoods(models, block_part, xp, xq, z, site_rates)
     acc = _acc_dtype(lsite.dtype)
     _, _, log_min = scale_constants(acc, scale_exp)
@@ -410,13 +417,16 @@ def root_log_likelihood_from(models: DeviceModels, block_part: jax.Array,
     site_lnl = weights.astype(acc) * (jnp.log(lsite).astype(acc)
                                       + sc * log_min)       # [B, lane]
     block_lnl = jnp.sum(site_lnl, axis=1)                   # [B]
-    return jax.ops.segment_sum(block_lnl, block_part, num_segments=num_parts)
+    out = jax.ops.segment_sum(block_lnl, block_part, num_segments=num_parts)
+    if axis_name is not None:
+        out = jax.lax.psum(out, axis_name)
+    return out
 
 
 def newton_raphson_branch(models: DeviceModels, block_part: jax.Array,
                           weights: jax.Array, st: jax.Array, z0: jax.Array,
                           maxiters0: jax.Array, conv0: jax.Array,
-                          num_slots: int, site_rates=None):
+                          num_slots: int, site_rates=None, axis_name=None):
     """Branch-length Newton-Raphson to convergence, fully on device.
 
     Replaces the reference's host-driven NR loop with one Allreduce per
@@ -439,7 +449,8 @@ def newton_raphson_branch(models: DeviceModels, block_part: jax.Array,
 
     def derivs(z):
         d1, d2 = nr_derivatives(models, block_part, weights, st,
-                                z.astype(st.dtype), num_slots, site_rates)
+                                z.astype(st.dtype), num_slots, site_rates,
+                                axis_name)
         return d1.astype(acc), d2.astype(acc)
 
     def cond(s):
@@ -501,7 +512,7 @@ def sumtable(models: DeviceModels, block_part: jax.Array,
 
 def nr_derivatives(models: DeviceModels, block_part: jax.Array,
                    weights: jax.Array, st: jax.Array, z: jax.Array,
-                   num_slots: int, site_rates=None):
+                   num_slots: int, site_rates=None, axis_name=None):
     """(lnL', lnL'') w.r.t. lz summed over sites, per branch slot [C].
 
     Reference: `coreGAMMA_FLEX` / `coreGTRCAT` + derivative Allreduce
@@ -539,4 +550,7 @@ def nr_derivatives(models: DeviceModels, block_part: jax.Array,
                              num_segments=num_slots)
     d2 = jax.ops.segment_sum(per_part_d2, models.part_branch,
                              num_segments=num_slots)
+    if axis_name is not None:                # shard_map (SEV x sharding):
+        d1 = jax.lax.psum(d1, axis_name)     # the derivative Allreduce
+        d2 = jax.lax.psum(d2, axis_name)     # (makenewz...c:1241-1248)
     return d1, d2

@@ -23,9 +23,9 @@ is stored whole — the reference compacts per site, so its ratio is better
 on alignments whose gaps do not align to 128-column runs; block
 granularity is what keeps every shape static for XLA.
 
-SEV x sharding — design (not yet wired):
-The obstacle is ONLY that the pool's cell axis is irregular while the
-mesh shards the block axis.  The composition that preserves both:
+SEV x sharding — WIRED (round 4; `-S` no longer forces single-device,
+parallel/launch.py): the pool's cell axis is irregular while the mesh
+shards the block axis, and the composition that preserves both is:
 
 1. Partition the block axis over the mesh exactly as the dense path
    does (contiguous ranges of B, `parallel/packing.py`).
@@ -43,15 +43,19 @@ mesh shards the block axis.  The composition that preserves both:
    max over devices of that device's cell count (pow2-bucketed like
    today); gappy regions are typically spatially clustered, so the
    waste is bounded by one growth bucket.
-5. Multi-host selective loading composes for free: gap bitsets derive
-   from tip codes, which the sliced reader already delivers per block
-   range (`io/bytefile.py`).
+5. Per-process SELECTIVE loading does NOT compose yet: SevState's gap
+   bitsets and cell bookkeeping span the global block axis, so `-S`
+   multi-process jobs read the whole byteFile per process
+   (cli/main.py selective_read_decision forces "whole").  Localizing
+   the bitsets per block range is the remaining step.
 
-Cost estimate: the engine change is mechanical (today's `_state()`
-tuple moves inside `shard_map`); the host change is indexing bitsets by
-block range.  Deferred because `-S` exists to save MEMORY, and the
-first-order memory win at scale is per-process selective loading +
-sharded dense arenas, which already landed this round.
+Implementation map: per-device cell regions + uniform cap in SevState
+below; shard_map program construction in
+engine._build_sev_mapped_programs; explicit lnL/derivative psums via
+the kernels' axis_name; equivalence tests
+tests/test_sev.py::test_sev_sharded_*.  The batched SPR scan program is
+not mapped yet — SEV x sharded searches keep the sequential lazy arm
+(spr.batched_scan_enabled gates it off).
 """
 
 from __future__ import annotations
@@ -72,9 +76,28 @@ class SevState:
     """Host bookkeeping + device arrays for one engine's CLV pool."""
 
     def __init__(self, tip_codes: np.ndarray, undetermined_code: int,
-                 num_rows: int, B: int, lane: int, R: int, K: int, dtype):
+                 num_rows: int, B: int, lane: int, R: int, K: int, dtype,
+                 ndev: int = 1, zeros_pool=None, put_slot=None):
+        """ndev > 1 activates the sharded layout (SEV x sharding, design
+        notes above): the block axis is split into `ndev` contiguous
+        ranges, every cell id is LOCAL to its range's pool region, and
+        the device pool is [ndev * cap, lane, R, K] — under shard_map
+        each device sees exactly its [cap, ...] region and the local ids
+        index it directly.  zeros_pool(shape, dtype) allocates the pool
+        (the engine passes a born-sharded allocator — the pool must
+        never stage whole on one device) and put_slot places slot maps;
+        defaults are plain jnp for the single-device case."""
+        if B % max(ndev, 1):
+            raise ValueError(f"SEV x sharding needs the block count ({B}) "
+                             f"divisible by the mesh size ({ndev}); the "
+                             "packing planner pads blocks to the mesh")
         self.B, self.lane, self.R, self.K = B, lane, R, K
         self.dtype = dtype
+        self.ndev = max(ndev, 1)
+        self.B_local = B // self.ndev
+        self._zeros_pool = zeros_pool or (
+            lambda shape, dt: jnp.zeros(shape, dtype=dt))
+        self._put_slot = put_slot or jnp.asarray
         ntips = tip_codes.shape[0]
         codes = tip_codes.reshape(ntips, B, lane)
         self.tip_gap = (codes == undetermined_code).all(axis=2)  # [ntips, B]
@@ -82,10 +105,10 @@ class SevState:
         self.num_rows = num_rows
         self.node_gap = np.ones((num_rows, B), dtype=bool)
         self.cell_of = np.full((num_rows, B), -1, dtype=np.int64)
-        self.free: List[int] = []
-        self.next_cell = FIRST_DATA_CELL
-        self.cap = 0
-        self.pool = None                      # device [S, lane, R, K]
+        self.free: List[List[int]] = [[] for _ in range(self.ndev)]
+        self.next_cell: List[int] = [FIRST_DATA_CELL] * self.ndev
+        self.cap = 0                          # per-device region capacity
+        self.pool = None                      # device [ndev*cap, lane, R, K]
         self.slot_read = None                 # device [num_rows, B] int32
         self.slot_write = None
         self.dirty = True
@@ -100,6 +123,7 @@ class SevState:
     def update_for_entries(self, entries: List[TraversalEntry]) -> None:
         """Refresh gap bits + cell allocations for nodes about to be
         recomputed (post-order, so children update before parents)."""
+        Bl = self.B_local
         for e in entries:
             row = e.parent - self.ntips - 1
             g = self._gap_of(e.left) & self._gap_of(e.right)
@@ -107,24 +131,31 @@ class SevState:
             have = self.cell_of[row] >= 0
             if not np.array_equal(need, have):
                 self.dirty = True
-                drop = have & ~need
-                if drop.any():
-                    self.free.extend(int(c) for c in self.cell_of[row][drop])
-                    self.cell_of[row][drop] = -1
-                grow = need & ~have
-                n = int(grow.sum())
-                if n:
-                    self.cell_of[row][grow] = self._alloc(n)
+                # Allocation is per device range: a cell id is local to
+                # the range that owns its block, so drop/grow masks are
+                # processed range by range.
+                for d in range(self.ndev):
+                    sl = slice(d * Bl, (d + 1) * Bl)
+                    co = self.cell_of[row, sl]
+                    drop = have[sl] & ~need[sl]
+                    if drop.any():
+                        self.free[d].extend(int(c) for c in co[drop])
+                        co[drop] = -1
+                    grow = need[sl] & ~have[sl]
+                    n = int(grow.sum())
+                    if n:
+                        co[grow] = self._alloc(n, d)
             self.node_gap[row] = g
 
-    def _alloc(self, n: int) -> np.ndarray:
+    def _alloc(self, n: int, d: int = 0) -> np.ndarray:
         out = np.empty(n, dtype=np.int64)
-        take = min(n, len(self.free))
+        free = self.free[d]
+        take = min(n, len(free))
         for i in range(take):
-            out[i] = self.free.pop()
+            out[i] = free.pop()
         for i in range(take, n):
-            out[i] = self.next_cell
-            self.next_cell += 1
+            out[i] = self.next_cell[d]
+            self.next_cell[d] += 1
         return out
 
     # -- batched-scan scratch region ----------------------------------------
@@ -147,7 +178,11 @@ class SevState:
             grow = next_pow2(n) - self.scan_cap
             self.node_gap = np.concatenate(
                 [self.node_gap, np.zeros((grow, self.B), dtype=bool)])
-            new_cells = self._alloc(grow * self.B).reshape(grow, self.B)
+            new_cells = np.empty((grow, self.B), dtype=np.int64)
+            Bl = self.B_local
+            for d in range(self.ndev):
+                new_cells[:, d * Bl:(d + 1) * Bl] = self._alloc(
+                    grow * Bl, d).reshape(grow, Bl)
             self.cell_of = np.concatenate([self.cell_of, new_cells])
             self.num_rows += grow
             self.scan_cap += grow
@@ -158,21 +193,31 @@ class SevState:
     # -- device sync ---------------------------------------------------------
 
     def sync(self) -> None:
-        """Grow the pool if needed and re-upload slot maps if changed."""
-        if self.pool is None or self.next_cell > self.cap:
-            new_cap = max(64, int(self.next_cell * 1.3) + 8)
-            new_pool = jnp.zeros((new_cap, self.lane, self.R, self.K),
-                                 dtype=self.dtype)
-            new_pool = new_pool.at[ONES_CELL].set(1.0)
+        """Grow the pool if needed and re-upload slot maps if changed.
+
+        The per-device region capacity is uniform (max over devices,
+        static shapes for shard_map); growth copies each region into its
+        slice of the new pool, so local cell ids stay valid."""
+        max_next = max(self.next_cell)
+        if self.pool is None or max_next > self.cap:
+            new_cap = max(64, int(max_next * 1.3) + 8)
+            new_pool = self._zeros_pool(
+                (self.ndev * new_cap, self.lane, self.R, self.K),
+                self.dtype)
+            bases = np.arange(self.ndev, dtype=np.int64) * new_cap
+            new_pool = new_pool.at[bases + ONES_CELL].set(1.0)
             if self.pool is not None:
-                new_pool = new_pool.at[:self.cap].set(self.pool)
+                for d in range(self.ndev):
+                    new_pool = new_pool.at[
+                        d * new_cap:d * new_cap + self.cap].set(
+                        self.pool[d * self.cap:(d + 1) * self.cap])
             self.pool = new_pool
             self.cap = new_cap
         if self.dirty:
-            self.slot_read = jnp.asarray(
+            self.slot_read = self._put_slot(
                 np.where(self.cell_of >= 0, self.cell_of,
                          ONES_CELL).astype(np.int32))
-            self.slot_write = jnp.asarray(
+            self.slot_write = self._put_slot(
                 np.where(self.cell_of >= 0, self.cell_of,
                          SCRATCH_CELL).astype(np.int32))
             self.dirty = False
@@ -180,7 +225,9 @@ class SevState:
     # -- reporting ------------------------------------------------------------
 
     def stats(self) -> dict:
-        allocated = self.next_cell - FIRST_DATA_CELL - len(self.free)
+        allocated = (sum(self.next_cell)
+                     - self.ndev * FIRST_DATA_CELL
+                     - sum(len(f) for f in self.free))
         dense = self.num_rows * self.B
         return {
             "allocated_cells": int(allocated),

@@ -76,12 +76,12 @@ def select_sharding(args, save_memory: bool,
     n = len(jax.devices())
     if n <= 1:
         return None
-    if save_memory:
-        log("-S (SEV) does not compose with site-axis sharding; "
-            "running on one device (drop -S to use all "
-            f"{n} devices)")
-        return None
     sh = site_sharding(make_mesh())
-    log(f"site axis sharded over {n} devices "
-        f"({jax.process_count()} process(es))")
+    if save_memory:
+        log(f"-S (SEV) sharded: per-device CLV pool regions over {n} "
+            "devices (shard_map; lazy SPR scan runs sequential "
+            "primitives)")
+    else:
+        log(f"site axis sharded over {n} devices "
+            f"({jax.process_count()} process(es))")
     return sh

@@ -233,3 +233,87 @@ def test_sev_search_smoke(gappy):
                                           max_rearrange=4,
                                           estimate_model=False))
     assert res.likelihood > start
+
+
+@pytest.mark.slow
+def test_sev_sharded_matches_single_device(gappy):
+    """SEV x sharding: the shard_mapped pooled programs on an 8-device
+    mesh must reproduce the single-device SEV engine bit-for-bit — the
+    pool is per-device regions with local cell ids, and the lnL /
+    derivative reductions are explicit psums (ops/sev.py design notes,
+    engine._build_sev_mapped_programs).  Reference scope: `-S` under
+    full MPI distribution (`axml.c:874-876`)."""
+    from examl_tpu.parallel.sharding import default_site_sharding
+
+    sh = default_site_sharding(8)
+    one = PhyloInstance(gappy, save_memory=True, block_multiple=8)
+    many = PhyloInstance(gappy, save_memory=True, sharding=sh,
+                         block_multiple=8)
+    t1 = one.random_tree(7)
+    t2 = many.random_tree(7)
+    l1 = float(one.evaluate(t1, full=True))
+    l2 = float(many.evaluate(t2, full=True))
+    assert l1 == pytest.approx(l2, abs=1e-9)
+
+    # partial traversal after a branch change
+    p1 = t1.nodep[t1.inner_numbers()[2]]
+    p2 = t2.nodep[t2.inner_numbers()[2]]
+    for p, inst, tree in ((p1, one, t1), (p2, many, t2)):
+        p.z = [0.2] * len(p.z)
+        p.back.z = list(p.z)
+    l1p = float(one.evaluate(t1, p1))
+    l2p = float(many.evaluate(t2, p2))
+    assert l1p == pytest.approx(l2p, abs=1e-9)
+
+    # fused Newton-Raphson (derivative psum path)
+    z1 = one.makenewz(t1, p1, p1.back, p1.z, maxiter=16)
+    z2 = many.makenewz(t2, p2, p2.back, p2.z, maxiter=16)
+    np.testing.assert_allclose(np.asarray(z1), np.asarray(z2),
+                               rtol=0, atol=1e-12)
+
+    # pool actually saves memory per device
+    (es,) = many.engines.values()
+    st = es.sev.stats()
+    assert st["allocated_cells"] < st["dense_cells"] * 0.6, st
+
+
+@pytest.mark.slow
+def test_sev_sharded_spr_scan():
+    """The sequential SPR arm (the one SEV x sharding uses — the batched
+    scan is gated to fall back, spr.batched_scan_enabled) runs whole on
+    the shard_mapped programs: rearrange must score candidates, restore
+    the tree, and leave the pooled CLV state consistent."""
+    from examl_tpu.constants import UNLIKELY
+    from examl_tpu.parallel.sharding import default_site_sharding
+    from examl_tpu.search import spr
+
+    # Small on purpose: every distinct partial-traversal shape compiles
+    # its own shard_map program on the virtual 8-device mesh, and CPU
+    # compiles dominate this test's wall time.
+    names, seqs, model_text = _gappy_alignment(ntaxa=12, genes=2,
+                                               gene_sites=128, seed=5)
+    import tempfile, os
+    d = tempfile.mkdtemp()
+    mp = os.path.join(d, "parts.model")
+    with open(mp, "w") as f:
+        f.write(model_text + "\n")
+    from examl_tpu.io.partitions import parse_partition_file
+    small = build_alignment_data(names, seqs,
+                                 specs=parse_partition_file(mp))
+    sh = default_site_sharding(8)
+    inst = PhyloInstance(small, save_memory=True, sharding=sh,
+                         block_multiple=8)
+    tree = inst.random_tree(3)
+    lnl0 = float(inst.evaluate(tree, full=True))
+    assert not spr.batched_scan_enabled(inst)
+    ctx = spr.SprContext(inst, thorough=False, do_cutoff=False)
+    ctx.best_of_node = UNLIKELY
+    p = next(tree.nodep[n] for n in tree.inner_numbers()
+             if not tree.is_tip(tree.nodep[n].back.number))
+    assert spr.rearrange(inst, tree, ctx, p, 1, 2)
+    assert ctx.best_of_node > UNLIKELY
+    # tree restored: partial evaluate agrees with a clean recompute
+    lpart = float(inst.evaluate(tree, p))
+    lfull = float(inst.evaluate(tree, full=True))
+    assert lpart == pytest.approx(lfull, abs=5e-4)
+    assert lfull == pytest.approx(lnl0, abs=5e-4)
