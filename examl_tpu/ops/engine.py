@@ -289,6 +289,35 @@ class LikelihoodEngine:
             self._jit_derivs = jax.jit(self._derivs_impl)
         self._jit_rate_scan = jax.jit(self._rate_scan_impl)
 
+    def _sev_spec_vocab(self) -> dict:
+        """PartitionSpec vocabulary + shard_map wrapper for the SEV x
+        sharding programs — shared by the engine's core programs and the
+        batched-scan program (search/batchscan.py)."""
+        from jax.sharding import PartitionSpec as P
+
+        from examl_tpu.parallel.sharding import SITE_AXIS as AX
+
+        mesh = self.sharding.mesh
+        REP = P()
+
+        def wrap(impl, in_specs, out_specs, donate=()):
+            mapped = jax.shard_map(impl, mesh=mesh, in_specs=in_specs,
+                                   out_specs=out_specs)
+            return jax.jit(mapped, donate_argnums=donate)
+
+        return {
+            "rep": REP,
+            "pool": P(AX),                        # [ndev*cap, lane, R, K]
+            "scaler": P(None, AX),                # [rows, B, lane]
+            "aux": (P(None, AX), P(None, AX)),    # slot_read, slot_write
+            "blocks": P(AX),                      # block_part [B]
+            "sites": P(AX),                       # weights [B, lane]
+            "tips": kernels.TipState(codes=P(None, AX), table=REP),
+            "models": DeviceModels(*(REP,) * len(DeviceModels._fields)),
+            "traversal": Traversal(*(REP,) * len(Traversal._fields)),
+            "wrap": wrap,
+        }
+
     def _build_sev_mapped_programs(self) -> None:
         """SEV x sharding: the pooled programs run under `jax.shard_map`.
 
@@ -302,25 +331,11 @@ class LikelihoodEngine:
         emit when axis_name is set (the reference's MPI Allreduces,
         `evaluateGenericSpecial.c:968-973`,
         `makenewzGenericSpecial.c:1241-1248`)."""
-        from jax.sharding import PartitionSpec as P
-
-        from examl_tpu.parallel.sharding import SITE_AXIS as AX
-
-        mesh = self.sharding.mesh
-        REP = P()
-        pool_s = P(AX)                       # [ndev*cap, lane, R, K]
-        sc_s = P(None, AX)                   # [rows, B, lane]
-        aux_s = (P(None, AX), P(None, AX))   # slot_read, slot_write
-        b_s = P(AX)                          # block_part [B]
-        bl_s = P(AX)                         # weights [B, lane]
-        tips_s = kernels.TipState(codes=P(None, AX), table=REP)
-        dm_s = DeviceModels(*(REP,) * len(DeviceModels._fields))
-        tv_s = Traversal(*(REP,) * len(Traversal._fields))
-
-        def wrap(impl, in_specs, out_specs, donate=()):
-            mapped = jax.shard_map(impl, mesh=mesh, in_specs=in_specs,
-                                   out_specs=out_specs)
-            return jax.jit(mapped, donate_argnums=donate)
+        v = self._sev_spec_vocab()
+        (REP, pool_s, sc_s, aux_s, b_s, bl_s, tips_s, dm_s, tv_s,
+         wrap) = (v["rep"], v["pool"], v["scaler"], v["aux"], v["blocks"],
+                  v["sites"], v["tips"], v["models"], v["traversal"],
+                  v["wrap"])
 
         self._jit_traverse = wrap(
             self._traverse_only_impl,
@@ -341,13 +356,14 @@ class LikelihoodEngine:
             (pool_s, sc_s, aux_s, tv_s, REP, REP, REP, REP, REP, dm_s,
              b_s, bl_s, tips_s, None),
             (pool_s, sc_s, REP), donate=(0, 1))
+        st_s = b_s                          # sumtable [B, lane, R, K]
         self._jit_sumtable = wrap(
             self._sumtable_impl,
             (pool_s, sc_s, aux_s, REP, REP, dm_s, b_s, tips_s),
-            P(AX))
+            st_s)
         self._jit_derivs = wrap(
             self._derivs_impl,
-            (P(AX), REP, dm_s, b_s, bl_s, None),
+            (st_s, REP, dm_s, b_s, bl_s, None),
             (REP, REP))
 
     # -- construction helpers ---------------------------------------------

@@ -52,10 +52,10 @@ shards the block axis, and the composition that preserves both is:
 Implementation map: per-device cell regions + uniform cap in SevState
 below; shard_map program construction in
 engine._build_sev_mapped_programs; explicit lnL/derivative psums via
-the kernels' axis_name; equivalence tests
-tests/test_sev.py::test_sev_sharded_*.  The batched SPR scan program is
-not mapped yet — SEV x sharded searches keep the sequential lazy arm
-(spr.batched_scan_enabled gates it off).
+the kernels' axis_name; the batched SPR scan maps the same way
+(search/batchscan.py scan_program, candidate lnLs psummed); equivalence
+tests tests/test_sev.py::test_sev_sharded_*.  The batched THOROUGH arm
+stays dense-only, as on single-device -S.
 """
 
 from __future__ import annotations

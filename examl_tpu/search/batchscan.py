@@ -283,9 +283,27 @@ def scan_program(eng, n_chunks: int):
             return carry, jnp.sum(site_lnl, axis=(1, 2))  # [T]
 
         _, lnls = jax.lax.scan(chunk, 0, (qg, upg, zc))
+        if eng._axis_name is not None:
+            # SEV x sharding: ONE explicit lnL Allreduce per dispatch
+            # for the whole candidate window (hoisted out of the scan —
+            # a per-chunk psum would serialize latency-bound collectives).
+            lnls = jax.lax.psum(lnls, eng._axis_name)
         return clv, scaler, lnls.reshape(-1)
 
-    fn = jax.jit(impl, donate_argnums=(0, 1))
+    if eng._axis_name is not None:
+        # SEV x sharding: same shard_map treatment as the engine's core
+        # programs (engine._sev_spec_vocab) — each device scans its pool
+        # region / block range, candidate lnLs psum across the mesh.
+        v = eng._sev_spec_vocab()
+        REP = v["rep"]
+        fn = v["wrap"](
+            impl,
+            (v["pool"], v["scaler"], v["aux"], v["traversal"], REP, REP,
+             REP, REP, REP, v["models"], v["blocks"], v["sites"],
+             v["tips"], None),
+            (v["pool"], v["scaler"], REP), donate=(0, 1))
+    else:
+        fn = jax.jit(impl, donate_argnums=(0, 1))
     eng._fast_jit_cache[key] = fn
     return fn
 

@@ -394,14 +394,6 @@ def batched_scan_enabled(inst: PhyloInstance) -> bool:
     import os
     if os.environ.get("EXAML_BATCH_SCAN") == "0":
         return False
-    # SEV x SHARDED engines run their core programs under shard_map
-    # (per-device pool regions); the scan program is not mapped yet, so
-    # that combination keeps the sequential lazy arm (which IS mapped,
-    # through the engine's evaluate/newton programs).
-    if any(getattr(e, "save_memory", False)
-           and getattr(e, "sharding", None) is not None
-           for e in inst.engines.values()):
-        return False
     if os.environ.get("EXAML_BATCH_SCAN") == "1":
         return True
     return _on_accelerator(inst)

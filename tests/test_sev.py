@@ -277,35 +277,38 @@ def test_sev_sharded_matches_single_device(gappy):
     assert st["allocated_cells"] < st["dense_cells"] * 0.6, st
 
 
+def _small_gappy_ad(tmpdir):
+    """12-taxon 2-gene gappy alignment for the sharded tests (small on
+    purpose: every distinct traversal shape compiles its own shard_map
+    program on the virtual 8-device mesh)."""
+    import os
+    names, seqs, model_text = _gappy_alignment(ntaxa=12, genes=2,
+                                               gene_sites=128, seed=5)
+    mp = os.path.join(str(tmpdir), "parts.model")
+    with open(mp, "w") as f:
+        f.write(model_text + "\n")
+    from examl_tpu.io.partitions import parse_partition_file
+    return build_alignment_data(names, seqs,
+                                specs=parse_partition_file(mp))
+
+
 @pytest.mark.slow
 def test_sev_sharded_spr_scan():
-    """The sequential SPR arm (the one SEV x sharding uses — the batched
-    scan is gated to fall back, spr.batched_scan_enabled) runs whole on
-    the shard_mapped programs: rearrange must score candidates, restore
-    the tree, and leave the pooled CLV state consistent."""
+    """The SEQUENTIAL SPR arm (pinned here by calling spr.rearrange
+    directly; the batched arm has its own equivalence test below) runs
+    whole on the shard_mapped programs: rearrange must score candidates,
+    restore the tree, and leave the pooled CLV state consistent."""
     from examl_tpu.constants import UNLIKELY
     from examl_tpu.parallel.sharding import default_site_sharding
     from examl_tpu.search import spr
 
-    # Small on purpose: every distinct partial-traversal shape compiles
-    # its own shard_map program on the virtual 8-device mesh, and CPU
-    # compiles dominate this test's wall time.
-    names, seqs, model_text = _gappy_alignment(ntaxa=12, genes=2,
-                                               gene_sites=128, seed=5)
-    import tempfile, os
-    d = tempfile.mkdtemp()
-    mp = os.path.join(d, "parts.model")
-    with open(mp, "w") as f:
-        f.write(model_text + "\n")
-    from examl_tpu.io.partitions import parse_partition_file
-    small = build_alignment_data(names, seqs,
-                                 specs=parse_partition_file(mp))
+    import tempfile
+    small = _small_gappy_ad(tempfile.mkdtemp())
     sh = default_site_sharding(8)
     inst = PhyloInstance(small, save_memory=True, sharding=sh,
                          block_multiple=8)
     tree = inst.random_tree(3)
     lnl0 = float(inst.evaluate(tree, full=True))
-    assert not spr.batched_scan_enabled(inst)
     ctx = spr.SprContext(inst, thorough=False, do_cutoff=False)
     ctx.best_of_node = UNLIKELY
     p = next(tree.nodep[n] for n in tree.inner_numbers()
@@ -317,3 +320,34 @@ def test_sev_sharded_spr_scan():
     lfull = float(inst.evaluate(tree, full=True))
     assert lpart == pytest.approx(lfull, abs=5e-4)
     assert lfull == pytest.approx(lnl0, abs=5e-4)
+
+
+@pytest.mark.slow
+def test_sev_sharded_batched_scan_matches_single(monkeypatch):
+    """The shard_mapped batched SPR scan (one dispatch per pruned node,
+    psummed candidate lnLs) must score identically to the single-device
+    SEV batched scan."""
+    from examl_tpu.constants import UNLIKELY
+    from examl_tpu.parallel.sharding import default_site_sharding
+    from examl_tpu.search import spr
+
+    monkeypatch.setenv("EXAML_BATCH_SCAN", "1")
+    import tempfile
+    ad = _small_gappy_ad(tempfile.mkdtemp())
+    sh = default_site_sharding(8)
+    outcomes = []
+    for sharding in (None, sh):
+        inst = PhyloInstance(ad, save_memory=True, sharding=sharding,
+                             block_multiple=8)
+        assert spr.batched_scan_enabled(inst)
+        tree = inst.random_tree(3)
+        inst.evaluate(tree, full=True)
+        ctx = spr.SprContext(inst, thorough=False, do_cutoff=False)
+        ctx.best_of_node = UNLIKELY
+        p = next(tree.nodep[n] for n in tree.inner_numbers()
+                 if not tree.is_tip(tree.nodep[n].back.number))
+        assert spr.rearrange_batched(inst, tree, ctx, p, 1, 2)
+        outcomes.append((ctx.best_of_node, ctx.end_lh))
+    (b1, e1), (b2, e2) = outcomes
+    assert b1 == pytest.approx(b2, abs=1e-8)
+    assert e1 == pytest.approx(e2, abs=1e-8)
