@@ -190,17 +190,16 @@ def selective_read_decision(model: str, is_bytefile: bool,
     * "whole": every process reads the full file (single-process jobs;
       AUTO-protein partitions, whose BIC/AICc sample sizes must be
       global; non-byteFile inputs);
-    * "error": PSR in a multi-process job — its rate scan fetches
-      block-sharded per-site arrays to the host, impossible once shards
-      span other processes; refusing at startup beats burning the
-      model-opt prefix before a deep crash.
+    * "error": currently unreachable — kept for future hard
+      incompatibilities so callers keep handling it.
     """
     if nprocs <= 1:
         return "whole", "single process"
     if model == "PSR":
-        return "error", ("-m PSR does not support multi-process "
-                         "execution yet (per-site rate state is "
-                         "host-global); run single-process or use GAMMA")
+        return "whole", ("-m PSR multi-process: per-site scan results "
+                         "allgather to every process (the reference's "
+                         "CAT Gatherv/Scatterv, optimizeModel.c:2135-"
+                         "2254); whole-file read per process")
     if not is_bytefile:
         return "whole", "input is not a byteFile"
     if has_auto_aa:

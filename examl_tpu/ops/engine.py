@@ -195,9 +195,11 @@ class LikelihoodEngine:
         self.models = stack_models(models, branch_indices, self.dtype,
                                    psr=psr)
         # Per-site rate multipliers (PSR/CAT model); None selects the
-        # GAMMA path in every kernel.
-        self.site_rates = (jnp.ones((B, lane, 1), dtype=self.dtype)
-                           if psr else None)
+        # GAMMA path in every kernel.  Placed like every per-site tensor
+        # (block axis sharded) so multi-process jobs hold a global array.
+        self.site_rates = (self._put_blocks(
+            np.ones((B, lane, 1), dtype=self.dtype), lambda s: s.sites)
+            if psr else None)
 
         Bl = bucket.local_num_blocks
         self.block_part = self._put_blocks(
@@ -218,20 +220,13 @@ class LikelihoodEngine:
                 _pool_sh = NamedSharding(sharding.mesh, _P(_SA))
                 _slot_sh = NamedSharding(sharding.mesh, _P(None, _SA))
 
-                def zeros_pool(shape, dt):
-                    # Born sharded: -S exists because the pool only fits
-                    # when split across devices, so it must never stage
-                    # whole on one device (same invariant as
-                    # _zeros_sharded for the dense arena).
-                    npdt = np.dtype(dt)
-
-                    def shard_zeros(idx):
-                        return np.zeros(tuple(
-                            len(range(*sl.indices(dim)))
-                            for sl, dim in zip(idx, shape)), dtype=npdt)
-
-                    return jax.make_array_from_callback(
-                        tuple(shape), _pool_sh, shard_zeros)
+                # Born sharded: -S exists because the pool only fits
+                # when split across devices, so it must never stage
+                # whole on one device (reuses the dense arena's
+                # born-sharded allocator).
+                zeros_pool = (lambda shape, dt:
+                              self._zeros_sharded(shape, dt,
+                                                  lambda _: _pool_sh))
 
                 put_slot = lambda x: jax.device_put(jnp.asarray(x),
                                                     _slot_sh)
@@ -488,10 +483,15 @@ class LikelihoodEngine:
         return self.ntips + int(self.row_map[num])
 
     def set_site_rates(self, rates: np.ndarray) -> None:
-        """Install per-site rate multipliers [B, lane] (PSR model)."""
+        """Install per-site rate multipliers [B, lane] (PSR model).
+
+        `rates` is the GLOBAL array (identical on every process in a
+        multi-host job); placement shards the block axis like every
+        other per-site tensor."""
         assert self.psr
-        self.site_rates = jnp.asarray(
-            rates.reshape(self.B, self.lane, 1), dtype=self.dtype)
+        self.site_rates = self._put_blocks(
+            np.asarray(rates, dtype=self.dtype).reshape(
+                self.B, self.lane, 1), lambda s: s.sites)
 
     def _pallas_failed(self, exc: Exception) -> None:
         """Permanently demote this engine to the validated XLA fast path
@@ -1091,11 +1091,23 @@ class LikelihoodEngine:
         assert self.psr
         tv = self._traversal_arrays(entries)
         zv = jnp.asarray(_z_slots(z, self.num_branch_slots), dtype=self.dtype)
+        grid_dev = self._put_blocks(
+            np.asarray(grid, dtype=self.dtype), lambda s: s.sites)
         out = self._jit_rate_scan(
             self.tips, tv, jnp.int32(self._gidx(p_num)),
-            jnp.int32(self._gidx(q_num)), zv,
-            jnp.asarray(grid, dtype=self.dtype), self.models,
+            jnp.int32(self._gidx(q_num)), zv, grid_dev, self.models,
             self.block_part)
+        if self.sharding is not None and jax.process_count() > 1:
+            # Multi-host: the per-site scan result is block-sharded
+            # across processes; the host-side PSR crawl/categorization
+            # needs the global view on EVERY process (deterministic, so
+            # all processes categorize identically — the reference
+            # gathers to rank 0 and scatters back instead,
+            # `optimizeModel.c:2135-2254`; an allgather of the same
+            # payload replaces both legs).
+            from jax.experimental import multihost_utils
+            return np.asarray(
+                multihost_utils.process_allgather(out, tiled=True))
         return np.asarray(out)
 
     # -- branch derivatives ------------------------------------------------

@@ -207,10 +207,13 @@ class SevState:
             bases = np.arange(self.ndev, dtype=np.int64) * new_cap
             new_pool = new_pool.at[bases + ONES_CELL].set(1.0)
             if self.pool is not None:
-                for d in range(self.ndev):
-                    new_pool = new_pool.at[
-                        d * new_cap:d * new_cap + self.cap].set(
-                        self.pool[d * self.cap:(d + 1) * self.cap])
+                # one region-preserving copy (a per-region loop would
+                # materialize the full new pool ndev times)
+                new_pool = new_pool.reshape(
+                    self.ndev, new_cap, self.lane, self.R, self.K
+                ).at[:, :self.cap].set(self.pool.reshape(
+                    self.ndev, self.cap, self.lane, self.R, self.K)
+                ).reshape(self.ndev * new_cap, self.lane, self.R, self.K)
             self.pool = new_pool
             self.cap = new_cap
         if self.dirty:

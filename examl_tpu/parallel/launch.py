@@ -68,7 +68,7 @@ def init_distributed(args, log=lambda msg: None) -> None:
 def select_sharding(args, save_memory: bool,
                     log=lambda msg: None) -> Optional[SiteSharding]:
     """A site-axis sharding over every visible device, or None for the
-    single-device (or -S, which keeps its CLV pool host-resident) case."""
+    single-device case (-S shards too: per-device pool regions)."""
     if getattr(args, "single_device", False):
         return None
     import jax
@@ -79,8 +79,7 @@ def select_sharding(args, save_memory: bool,
     sh = site_sharding(make_mesh())
     if save_memory:
         log(f"-S (SEV) sharded: per-device CLV pool regions over {n} "
-            "devices (shard_map; lazy SPR scan runs sequential "
-            "primitives)")
+            "devices (shard_map, incl. the batched SPR scan)")
     else:
         log(f"site axis sharded over {n} devices "
             f"({jax.process_count()} process(es))")
