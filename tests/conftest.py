@@ -29,3 +29,19 @@ TESTDATA = "/root/reference/testData"
 
 def pytest_configure(config):
     config.addinivalue_line("markers", "slow: long-running end-to-end tests")
+
+
+def correlated_dna(ntaxa, nsites, seed=42, mut=0.15):
+    """Correlated random DNA (a shared mutation walk, so trees have real
+    signal) — the common generator for the e2e test fixtures."""
+    import numpy as np
+
+    from examl_tpu.io.alignment import build_alignment_data
+    rng = np.random.default_rng(seed)
+    cur = rng.integers(0, 4, nsites)
+    seqs = []
+    for _ in range(ntaxa):
+        flip = rng.random(nsites) < mut
+        cur = np.where(flip, rng.integers(0, 4, nsites), cur)
+        seqs.append("".join("ACGT"[c] for c in cur))
+    return build_alignment_data([f"t{i}" for i in range(ntaxa)], seqs)

@@ -4,6 +4,8 @@ and the RF convergence criterion."""
 import glob
 
 import numpy as np
+
+from tests.conftest import correlated_dna
 import pytest
 
 from examl_tpu.instance import PhyloInstance
@@ -14,19 +16,8 @@ from examl_tpu.search.raxml_search import SearchOptions, compute_big_rapid
 from examl_tpu.search.snapshots import topology_key
 
 
-def _correlated_dna(ntaxa, nsites, seed=42, mut=0.15):
-    rng = np.random.default_rng(seed)
-    cur = rng.integers(0, 4, nsites)
-    seqs = []
-    for _ in range(ntaxa):
-        flip = rng.random(nsites) < mut
-        cur = np.where(flip, rng.integers(0, 4, nsites), cur)
-        seqs.append("".join("ACGT"[c] for c in cur))
-    return build_alignment_data([f"t{i}" for i in range(ntaxa)], seqs)
-
-
 def test_relative_rf():
-    inst = PhyloInstance(_correlated_dna(10, 60, seed=3))
+    inst = PhyloInstance(correlated_dna(10, 60, seed=3))
     t1 = inst.random_tree(seed=1)
     t2 = inst.random_tree(seed=2)
     k1, k2 = topology_key(t1), topology_key(t2)
@@ -35,7 +26,7 @@ def test_relative_rf():
 
 
 def test_rf_convergence_signals_on_identical_trees():
-    inst = PhyloInstance(_correlated_dna(10, 60, seed=3))
+    inst = PhyloInstance(correlated_dna(10, 60, seed=3))
     t = inst.random_tree(seed=1)
     conv = RfConvergence(10)
     assert not conv(t, "fast", 0)          # first cycle: nothing to compare
@@ -47,7 +38,7 @@ def test_rf_convergence_signals_on_identical_trees():
 
 
 def test_checkpoint_write_restore_refuses_mismatch(tmp_path):
-    inst = PhyloInstance(_correlated_dna(10, 80))
+    inst = PhyloInstance(correlated_dna(10, 80))
     tree = inst.random_tree(seed=0)
     inst.evaluate(tree, full=True)
     mgr = CheckpointManager(str(tmp_path), "run1")
@@ -57,7 +48,7 @@ def test_checkpoint_write_restore_refuses_mismatch(tmp_path):
     assert len(glob.glob(str(tmp_path / "*.json.gz"))) == 2
 
     # Same config restores fine.
-    inst2 = PhyloInstance(_correlated_dna(10, 80))
+    inst2 = PhyloInstance(correlated_dna(10, 80))
     tree2 = inst2.random_tree(seed=5)
     resume = CheckpointManager(str(tmp_path), "run1").restore(inst2, tree2)
     assert resume["state"] == "FAST_SPRS"
@@ -66,14 +57,14 @@ def test_checkpoint_write_restore_refuses_mismatch(tmp_path):
     assert inst2.likelihood == pytest.approx(inst.likelihood, abs=1e-6)
 
     # Different alignment shape must be refused.
-    inst3 = PhyloInstance(_correlated_dna(10, 90))
+    inst3 = PhyloInstance(correlated_dna(10, 90))
     with pytest.raises(ValueError, match="different run configuration"):
         CheckpointManager(str(tmp_path), "run1").restore(
             inst3, inst3.random_tree(seed=1))
 
 
 def test_checkpoint_counter_resumes_numbering(tmp_path):
-    inst = PhyloInstance(_correlated_dna(10, 80))
+    inst = PhyloInstance(correlated_dna(10, 80))
     tree = inst.random_tree(seed=0)
     inst.evaluate(tree, full=True)
     mgr = CheckpointManager(str(tmp_path), "r")
@@ -86,7 +77,7 @@ def test_checkpoint_counter_resumes_numbering(tmp_path):
 def test_restart_reaches_continuous_result(tmp_path):
     """Search restarted from a mid-run checkpoint lands at (or above) the
     continuous run's final likelihood (reference restart semantics)."""
-    data = _correlated_dna(13, 250, seed=11)
+    data = correlated_dna(13, 250, seed=11)
 
     inst = PhyloInstance(data)
     tree = inst.random_tree(seed=4)
@@ -114,7 +105,7 @@ def test_rf_history_roundtrip():
     """RF-convergence evidence survives checkpoint serialization: a -D
     restart keeps comparing against the pre-restart cycle (reference
     `restartHashTable.c:279-357`)."""
-    inst = PhyloInstance(_correlated_dna(10, 60, seed=3))
+    inst = PhyloInstance(correlated_dna(10, 60, seed=3))
     t = inst.random_tree(seed=1)
     conv = RfConvergence(10)
     conv(t, "fast", 0)
@@ -141,7 +132,7 @@ def test_tree_evaluation_mode_restart(tmp_path):
     from examl_tpu.cli.main import main as cli_main
     from examl_tpu.io.bytefile import write_bytefile
 
-    data = _correlated_dna(12, 200, seed=5)
+    data = correlated_dna(12, 200, seed=5)
     inst = PhyloInstance(data)
     aln = str(tmp_path / "aln.binary")
     write_bytefile(aln, data)
@@ -207,7 +198,7 @@ def test_checkpoint_roundtrip_sharded_sev(tmp_path):
     (reference layout-independent restart, searchAlgo.c:1586-1648)."""
     from examl_tpu.parallel.sharding import default_site_sharding
 
-    data = _correlated_dna(12, 260, seed=3)
+    data = correlated_dna(12, 260, seed=3)
     sh = default_site_sharding(8)
     inst = PhyloInstance(data, save_memory=True, sharding=sh,
                          block_multiple=8)

@@ -143,14 +143,8 @@ def test_quartets_sharded_match_single_device(tmp_path):
     site distribution, `quartets.c:349-616`)."""
     from examl_tpu.parallel.sharding import default_site_sharding
 
-    rng = np.random.default_rng(5)
-    cur = rng.integers(0, 4, 300)
-    seqs = []
-    for _ in range(8):
-        flip = rng.random(300) < 0.2
-        cur = np.where(flip, rng.integers(0, 4, 300), cur)
-        seqs.append("".join("ACGT"[c] for c in cur))
-    ad = build_alignment_data([f"t{i}" for i in range(8)], seqs)
+    from tests.conftest import correlated_dna
+    ad = correlated_dna(8, 300, seed=5, mut=0.2)
 
     outs = []
     for tag, sharding in (("one", None), ("mesh", default_site_sharding(8))):

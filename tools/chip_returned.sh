@@ -1,0 +1,40 @@
+#!/bin/bash
+# Run when the axon chip answers a probe again after a wedge.
+#   bash tools/chip_returned.sh [outdir]
+#
+# Round-4 lesson (README §Performance): a client killed mid-compile
+# wedges the tunnel for HOURS.  bench.py is the only stage that kills
+# (its staged workers land the scan-tier primary metric FIRST, so even
+# a wedging kill still records a result); everything after it only
+# runs if the chip still answers, with no-kill generous timeouts.
+set -uo pipefail
+REPO=$(cd "$(dirname "$0")"/.. && pwd)
+OUT=${1:-/tmp/chip_returned}
+mkdir -p "$OUT"
+cd "$REPO"
+
+probe() {
+  timeout 180 python -c "import jax; jax.devices(); import jax.numpy as j; (j.ones((256,256))@j.ones((256,256))).block_until_ready()" \
+    >/dev/null 2>&1
+}
+
+echo "== probe =="
+probe || { echo "chip unreachable; aborting"; exit 1; }
+
+echo "== stage A: bench (staged workers, scan first — always lands) =="
+EXAML_BENCH_BUDGET_S=900 timeout 1800 python bench.py \
+  > "$OUT/bench.json" 2> "$OUT/bench.err"
+cat "$OUT/bench.json"
+
+echo "== re-probe before matrices (bench kills may have wedged) =="
+probe || { echo "tunnel wedged after bench; stop here"; exit 0; }
+
+echo "== stage B: variant matrix (no kills: let slow compiles finish) =="
+PYTHONPATH="$REPO${PYTHONPATH:+:$PYTHONPATH}" timeout 3000 \
+  python -u tools/perf_lab.py -H 2>&1 | tee "$OUT/perf_lab_H.log"
+
+probe || { echo "tunnel wedged after -H; stop"; exit 0; }
+echo "== stage C: large-config matrix =="
+PYTHONPATH="$REPO${PYTHONPATH:+:$PYTHONPATH}" timeout 3000 \
+  python -u tools/perf_lab.py -L 2>&1 | tee "$OUT/perf_lab_L.log"
+echo "done: $OUT"
