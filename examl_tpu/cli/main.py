@@ -205,11 +205,9 @@ def selective_read_decision(model: str, is_bytefile: bool,
     if has_auto_aa:
         return "whole", ("AUTO protein model selection needs global "
                          "sample sizes")
-    if save_memory:
-        return "whole", ("-S gap bookkeeping is host-global (SevState "
-                         "tip bitsets span all blocks); whole-file read "
-                         "per process")
-    return "slice", "selective byteFile read"
+    return "slice", ("selective byteFile read"
+                     + (" (-S gap bookkeeping follows the window)"
+                        if save_memory else ""))
 
 
 def _is_bytefile(path: str) -> bool:

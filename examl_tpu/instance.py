@@ -94,9 +94,6 @@ class PhyloInstance:
             if self.psr:
                 raise ValueError("per-process selective loading does not "
                                  "support PSR yet")
-            if save_memory:
-                raise ValueError("-S (SEV) does not compose with "
-                                 "per-process selective loading")
             self.buckets = pack_partitions_local(
                 alignment.partitions, procid, nprocs,
                 block_multiple=block_multiple)

@@ -212,7 +212,8 @@ class LikelihoodEngine:
         if save_memory:
             from examl_tpu.ops.sev import SevState
             self.clv = None
-            ndev = sharding.num_devices if sharding is not None else 1
+            gdev = sharding.num_devices if sharding is not None else 1
+            local_ndev, cap_reduce = gdev, None
             if sharding is not None:
                 from jax.sharding import NamedSharding
                 from jax.sharding import PartitionSpec as _P
@@ -228,14 +229,47 @@ class LikelihoodEngine:
                               self._zeros_sharded(shape, dt,
                                                   lambda _: _pool_sh))
 
-                put_slot = lambda x: jax.device_put(jnp.asarray(x),
-                                                    _slot_sh)
+                if bucket.is_local:
+                    # Multi-host selective loading: this process's
+                    # bookkeeping covers its block window only; slot
+                    # maps assemble globally from the local windows, and
+                    # the region capacity / dirty flag agree via a tiny
+                    # host allgather (the reference's per-rank data +
+                    # Allreduce'd bookkeeping, byteFile.c:278-382).
+                    b_per_dev = B // gdev
+                    if (bucket.local_num_blocks % b_per_dev
+                            or bucket.block_offset % b_per_dev):
+                        raise ValueError(
+                            "-S selective loading needs the process "
+                            "block window aligned to whole devices "
+                            f"(window {bucket.block_offset}+"
+                            f"{bucket.local_num_blocks} blocks, "
+                            f"{b_per_dev} blocks/device)")
+                    local_ndev = bucket.local_num_blocks // b_per_dev
+
+                    def cap_reduce(local_max, dirty):
+                        from jax.experimental import multihost_utils
+                        pair = multihost_utils.process_allgather(
+                            np.asarray([local_max, int(dirty)],
+                                       np.int64))
+                        return int(pair[:, 0].max()), bool(
+                            pair[:, 1].any())
+
+                    def put_slot(arr):
+                        return jax.make_array_from_process_local_data(
+                            _slot_sh, np.asarray(arr))
+                else:
+                    put_slot = lambda x: jax.device_put(jnp.asarray(x),
+                                                        _slot_sh)
             else:
                 zeros_pool = put_slot = None
             self.sev = SevState(bucket.tip_codes, self._undetermined_code(),
-                                self.num_rows, B, lane, self.R, self.K,
-                                self.storage_dtype, ndev=ndev,
-                                zeros_pool=zeros_pool, put_slot=put_slot)
+                                self.num_rows, bucket.local_num_blocks,
+                                lane, self.R, self.K,
+                                self.storage_dtype, ndev=local_ndev,
+                                zeros_pool=zeros_pool, put_slot=put_slot,
+                                global_regions=gdev,
+                                cap_reduce=cap_reduce)
         else:
             self.sev = None
             self.clv = self._zeros_sharded(

@@ -43,11 +43,13 @@ shards the block axis, and the composition that preserves both is:
    max over devices of that device's cell count (pow2-bucketed like
    today); gappy regions are typically spatially clustered, so the
    waste is bounded by one growth bucket.
-5. Per-process SELECTIVE loading does NOT compose yet: SevState's gap
-   bitsets and cell bookkeeping span the global block axis, so `-S`
-   multi-process jobs read the whole byteFile per process
-   (cli/main.py selective_read_decision forces "whole").  Localizing
-   the bitsets per block range is the remaining step.
+5. Per-process SELECTIVE loading composes: each process's SevState
+   covers only its block window (tip bitsets from the sliced reader,
+   `io/bytefile.py`), slot maps assemble globally from the local
+   windows (`make_array_from_process_local_data`), and the region
+   capacity + dirty flag agree through one tiny host allgather per
+   sync — called unconditionally so the collective stays aligned
+   across processes.
 
 Implementation map: per-device cell regions + uniform cap in SevState
 below; shard_map program construction in
@@ -77,24 +79,36 @@ class SevState:
 
     def __init__(self, tip_codes: np.ndarray, undetermined_code: int,
                  num_rows: int, B: int, lane: int, R: int, K: int, dtype,
-                 ndev: int = 1, zeros_pool=None, put_slot=None):
+                 ndev: int = 1, zeros_pool=None, put_slot=None,
+                 global_regions: int | None = None, cap_reduce=None):
         """ndev > 1 activates the sharded layout (SEV x sharding, design
-        notes above): the block axis is split into `ndev` contiguous
+        notes above): the block axis is split into contiguous per-device
         ranges, every cell id is LOCAL to its range's pool region, and
-        the device pool is [ndev * cap, lane, R, K] — under shard_map
-        each device sees exactly its [cap, ...] region and the local ids
-        index it directly.  zeros_pool(shape, dtype) allocates the pool
+        the device pool is [global_regions * cap, lane, R, K] — under
+        shard_map each device sees exactly its [cap, ...] region and the
+        local ids index it directly.
+
+        Multi-host selective loading: `tip_codes`/`B` cover only THIS
+        process's block window, `ndev` counts its LOCAL regions, and
+        `global_regions` the whole mesh; `cap_reduce(local_max_cells,
+        dirty)` returns the process-agreed (capacity target, any-dirty)
+        pair (an allgather — called on EVERY sync so the collective
+        stays aligned across processes, and a slot re-upload entered by
+        one process is entered by all).  zeros_pool(shape, dtype) allocates the pool
         (the engine passes a born-sharded allocator — the pool must
-        never stage whole on one device) and put_slot places slot maps;
-        defaults are plain jnp for the single-device case."""
+        never stage whole on one device) and put_slot places slot maps
+        (global assembly from the local window); defaults are plain jnp
+        for the single-device case."""
         if B % max(ndev, 1):
             raise ValueError(f"SEV x sharding needs the block count ({B}) "
-                             f"divisible by the mesh size ({ndev}); the "
-                             "packing planner pads blocks to the mesh")
+                             f"divisible by its region count ({ndev}); "
+                             "the packing planner pads blocks to the mesh")
         self.B, self.lane, self.R, self.K = B, lane, R, K
         self.dtype = dtype
         self.ndev = max(ndev, 1)
+        self.global_regions = global_regions or self.ndev
         self.B_local = B // self.ndev
+        self._cap_reduce = cap_reduce or (lambda x, d: (x, d))
         self._zeros_pool = zeros_pool or (
             lambda shape, dt: jnp.zeros(shape, dtype=dt))
         self._put_slot = put_slot or jnp.asarray
@@ -108,7 +122,7 @@ class SevState:
         self.free: List[List[int]] = [[] for _ in range(self.ndev)]
         self.next_cell: List[int] = [FIRST_DATA_CELL] * self.ndev
         self.cap = 0                          # per-device region capacity
-        self.pool = None                      # device [ndev*cap, lane, R, K]
+        self.pool = None         # device [global_regions*cap, lane, R, K]
         self.slot_read = None                 # device [num_rows, B] int32
         self.slot_write = None
         self.dirty = True
@@ -198,22 +212,30 @@ class SevState:
         The per-device region capacity is uniform (max over devices,
         static shapes for shard_map); growth copies each region into its
         slice of the new pool, so local cell ids stay valid."""
-        max_next = max(self.next_cell)
+        # cap_reduce runs UNCONDITIONALLY: in a multi-process job it is
+        # a collective (allgather), so every process must reach it on
+        # every sync regardless of local growth pressure.  The dirty
+        # flag reduces too (any-process-dirty -> all re-upload): slot
+        # assembly from local windows must be entered by every process.
+        max_next, dirty = self._cap_reduce(max(self.next_cell),
+                                           self.dirty)
+        self.dirty = bool(dirty)
+        max_next = int(max_next)
         if self.pool is None or max_next > self.cap:
             new_cap = max(64, int(max_next * 1.3) + 8)
+            G = self.global_regions
             new_pool = self._zeros_pool(
-                (self.ndev * new_cap, self.lane, self.R, self.K),
-                self.dtype)
-            bases = np.arange(self.ndev, dtype=np.int64) * new_cap
+                (G * new_cap, self.lane, self.R, self.K), self.dtype)
+            bases = np.arange(G, dtype=np.int64) * new_cap
             new_pool = new_pool.at[bases + ONES_CELL].set(1.0)
             if self.pool is not None:
                 # one region-preserving copy (a per-region loop would
-                # materialize the full new pool ndev times)
+                # materialize the full new pool G times)
                 new_pool = new_pool.reshape(
-                    self.ndev, new_cap, self.lane, self.R, self.K
+                    G, new_cap, self.lane, self.R, self.K
                 ).at[:, :self.cap].set(self.pool.reshape(
-                    self.ndev, self.cap, self.lane, self.R, self.K)
-                ).reshape(self.ndev * new_cap, self.lane, self.R, self.K)
+                    G, self.cap, self.lane, self.R, self.K)
+                ).reshape(G * new_cap, self.lane, self.R, self.K)
             self.pool = new_pool
             self.cap = new_cap
         if self.dirty:

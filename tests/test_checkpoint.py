@@ -3,13 +3,11 @@ and the RF convergence criterion."""
 
 import glob
 
-import numpy as np
-
-from tests.conftest import correlated_dna
 import pytest
 
+from tests.conftest import correlated_dna
+
 from examl_tpu.instance import PhyloInstance
-from examl_tpu.io.alignment import build_alignment_data
 from examl_tpu.search.checkpoint import CheckpointManager
 from examl_tpu.search.convergence import RfConvergence, relative_rf
 from examl_tpu.search.raxml_search import SearchOptions, compute_big_rapid

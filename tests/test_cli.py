@@ -127,4 +127,4 @@ def test_selective_read_decision_table():
     assert d("GAMMA", True, True, 4)[0] == "whole"      # AUTO protein
     assert d("PSR", True, False, 4)[0] == "whole"       # allgathered scan
     assert d("PSR", True, False, 1)[0] == "whole"       # single-proc PSR ok
-    assert d("GAMMA", True, False, 4, save_memory=True)[0] == "whole"  # -S
+    assert d("GAMMA", True, False, 4, save_memory=True)[0] == "slice"  # -S

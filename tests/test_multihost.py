@@ -220,3 +220,84 @@ def test_multihost_psr_rate_optimization():
     (a0, a1), (b0, b1) = vals
     assert a0 == b0 and a1 == b1           # processes agree exactly
     assert a1 > a0 + 100.0                 # categorization really helped
+
+
+SEV_CHILD = """
+import sys; sys.path.insert(0, {repo!r})
+import jax
+jax.distributed.initialize(coordinator_address="127.0.0.1:{port}",
+                           num_processes=2, process_id={procid})
+from examl_tpu.io.bytefile import read_bytefile_for_process
+from examl_tpu.instance import PhyloInstance
+from examl_tpu.parallel.sharding import default_site_sharding
+
+ndev = jax.device_count()
+sl = read_bytefile_for_process({bf!r}, {procid}, 2, block_multiple=ndev)
+print("local_patterns:", sum(p.width for p in sl.partitions))
+inst = PhyloInstance(sl, sharding=default_site_sharding(),
+                     block_multiple=ndev, local_window=({procid}, 2),
+                     save_memory=True)
+tree = inst.tree_from_newick(open({tree!r}).read())
+lnl = float(inst.evaluate(tree, full=True))
+(eng,) = inst.engines.values()
+st = eng.sev.stats()
+print("lnL= %.6f" % lnl)
+print("alloc=", st["allocated_cells"], " dense=", st["dense_cells"])
+"""
+
+
+def test_multihost_sev_selective_load(tmp_path):
+    """-S with per-process selective loading: each process reads only
+    its site columns, keeps gap bookkeeping for its own block window,
+    and the shard_mapped pooled programs reproduce the whole-read
+    single-process SEV lnL — the reference's -S under MPI with per-rank
+    reads (`axml.c:874-876`, `byteFile.c:278-382`)."""
+    import re
+
+    from examl_tpu.instance import PhyloInstance
+    from examl_tpu.io.bytefile import write_bytefile
+    from examl_tpu.io.partitions import parse_partition_file
+    from examl_tpu.io.alignment import build_alignment_data
+
+    # gappy two-gene alignment, wide enough for 2 procs x 4 devices
+    import numpy as np
+    rng = np.random.default_rng(8)
+    ntaxa, gene = 16, 640
+    names = [f"t{i}" for i in range(ntaxa)]
+    seqs = ["" for _ in range(ntaxa)]
+    for g in range(2):
+        cov = range(g * ntaxa // 2, (g + 1) * ntaxa // 2)
+        for i in range(ntaxa):
+            if i in cov:
+                seqs[i] += "".join("ACGT"[b]
+                                   for b in rng.integers(0, 4, gene))
+            else:
+                seqs[i] += "-" * gene
+    mp = tmp_path / "parts.model"
+    mp.write_text(f"DNA, g1 = 1-{gene}\nDNA, g2 = {gene+1}-{2*gene}\n")
+    data = build_alignment_data(names, seqs,
+                                specs=parse_partition_file(str(mp)))
+    bf = str(tmp_path / "gappy.binary")
+    write_bytefile(bf, data)
+
+    inst = PhyloInstance(data, save_memory=True)   # whole-read reference
+    tree = inst.random_tree(11)
+    treef = tmp_path / "t.nwk"
+    treef.write_text(tree.to_newick(data.taxon_names))
+    ref = float(inst.evaluate(tree, full=True))
+
+    port = _free_port()
+    outs = _launch(
+        [SEV_CHILD.format(repo=REPO, port=port, procid=p, bf=bf,
+                          tree=str(treef)) for p in range(2)],
+        ndev=4, timeout=900)
+    lnls, allocs = [], []
+    for out in outs:
+        lnls.append(float(re.search(r"lnL= (-?[\d.]+)", out).group(1)))
+        m = re.search(r"alloc= (\d+)\s+dense= (\d+)", out)
+        allocs.append((int(m.group(1)), int(m.group(2))))
+    assert lnls[0] == lnls[1]
+    assert lnls[0] == pytest.approx(ref, abs=0.02)
+    # each process allocated cells for its window only, and saved memory
+    for a, dtot in allocs:
+        assert 0 < a < dtot
