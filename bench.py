@@ -67,8 +67,17 @@ DATA = "/root/reference/testData"
 # measures the real number on this host (writes tools/avx_baseline.json).
 FALLBACK_AVX_UPDATES_PER_SEC = 2.0e9
 
-TPU_PLAN = ["s-scan", "s-chunks", "s-pallas", "s-whole",
-            "L:dna-large", "L:aa-large", "prims"]
+# Order = information value under the wedge risk: the scan tier's
+# compile is hardware-proven, so it lands the primary metric AND the
+# compute-bound large configs FIRST; the chunk/Pallas tiers follow —
+# their compiles are the ones that have hung the tunnel (a killed
+# worker can wedge every later stage), so they must not be able to
+# cost the headline numbers.  Deliberate trade-off: on a fresh run the
+# large configs therefore always measure the SCAN variant (the
+# best-variant hint only helps resumed workers); if a faster tier
+# proves itself on hardware, promote it by reordering here.
+TPU_PLAN = ["s-scan", "L:dna-large", "L:aa-large",
+            "s-chunks", "s-pallas", "s-whole", "prims"]
 CPU_PLAN = ["s-scan", "s-chunks", "prims"]
 
 LARGE_CONFIGS = {
@@ -631,8 +640,10 @@ def _plan_from_env(cpu: bool):
                     f"bench: unknown EXAML_BENCH_LARGE config {tok!r} "
                     f"(known: {','.join(LARGE_CONFIGS)}); skipping\n")
         plan = [s for s in plan if not s.startswith("L:")]
-        # insert before prims, preserving request order
-        at = plan.index("prims") if "prims" in plan else len(plan)
+        # insert right after the safe scan stage, preserving request
+        # order (large configs outrank the hang-risky tiers — see
+        # TPU_PLAN ordering note)
+        at = plan.index("s-scan") + 1 if "s-scan" in plan else 0
         plan[at:at] = keep
     return plan
 

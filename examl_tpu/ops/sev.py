@@ -94,11 +94,11 @@ class SevState:
         dirty)` returns the process-agreed (capacity target, any-dirty)
         pair (an allgather — called on EVERY sync so the collective
         stays aligned across processes, and a slot re-upload entered by
-        one process is entered by all).  zeros_pool(shape, dtype) allocates the pool
-        (the engine passes a born-sharded allocator — the pool must
-        never stage whole on one device) and put_slot places slot maps
-        (global assembly from the local window); defaults are plain jnp
-        for the single-device case."""
+        one process is entered by all).  zeros_pool(shape, dtype)
+        allocates the pool (the engine passes a born-sharded allocator —
+        the pool must never stage whole on one device) and put_slot
+        places slot maps (global assembly from the local window);
+        defaults are plain jnp for the single-device case."""
         if B % max(ndev, 1):
             raise ValueError(f"SEV x sharding needs the block count ({B}) "
                              f"divisible by its region count ({ndev}); "
