@@ -236,6 +236,12 @@ class LikelihoodEngine:
                     # the region capacity / dirty flag agree via a tiny
                     # host allgather (the reference's per-rank data +
                     # Allreduce'd bookkeeping, byteFile.c:278-382).
+                    if B % gdev:
+                        raise ValueError(
+                            "-S selective loading needs the GLOBAL "
+                            f"block count ({B}) divisible by the mesh "
+                            f"size ({gdev}); pad the instance with "
+                            "block_multiple=num_devices")
                     b_per_dev = B // gdev
                     if (bucket.local_num_blocks % b_per_dev
                             or bucket.block_offset % b_per_dev):
@@ -598,10 +604,12 @@ class LikelihoodEngine:
                 if not done.wait(180.0):
                     import sys
                     sys.stderr.write(
-                        "EXAML: a fast-traversal compile has taken >180s "
+                        "EXAML: a device-program compile has taken >180s "
                         "— if this never returns, rerun with "
-                        "EXAML_FAST_TRAVERSAL=0 (scan tier) or "
-                        "EXAML_PALLAS=0.\n")
+                        "EXAML_FAST_TRAVERSAL=0 (scan tier), "
+                        "EXAML_PALLAS=0, or EXAML_BATCH_SCAN=0 "
+                        "(sequential SPR scans), depending on which "
+                        "program is compiling.\n")
 
             threading.Thread(target=bark, daemon=True).start()
             try:
@@ -610,6 +618,27 @@ class LikelihoodEngine:
                 done.set()
 
         return call
+
+    # -- shared program cache (LRU) -----------------------------------------
+    # External program builders (search/batchscan.py, quartets_batch.py)
+    # share _fast_jit_cache through these two helpers so they get the
+    # same move_to_end-on-hit / trim-on-insert / compile-watchdog
+    # discipline as the engine's own fast programs — without it a hot
+    # scan program sits at the LRU-oldest slot and wave-profile churn
+    # evicts it, and its recompile runs unguarded.
+
+    def cache_get(self, key):
+        fn = self._fast_jit_cache.get(key)
+        if fn is not None:
+            self._fast_jit_cache.move_to_end(key)
+        return fn
+
+    def cache_put(self, key, fn):
+        fn = self._guard_first_call(fn)
+        self._fast_jit_cache[key] = fn
+        while len(self._fast_jit_cache) > self._fast_jit_cache_cap:
+            self._fast_jit_cache.popitem(last=False)
+        return fn
 
     def _run_fast_traversal(self, entries: List[TraversalEntry]) -> None:
         if self.pallas_whole:
@@ -728,9 +757,8 @@ class LikelihoodEngine:
 
     def _whole_fn(self, E: int, with_eval: bool):
         key = ("whole", E, with_eval)
-        fn = self._fast_jit_cache.get(key)
+        fn = self.cache_get(key)
         if fn is not None:
-            self._fast_jit_cache.move_to_end(key)
             return fn
         from examl_tpu.ops import pallas_whole
 
@@ -749,13 +777,8 @@ class LikelihoodEngine:
                 self.num_parts, self.scale_exp, self.ntips, None)
             return clv, scaler, lnl
 
-        fn = self._guard_first_call(
-            jax.jit(impl_eval if with_eval else run,
-                    donate_argnums=(0, 1)))
-        self._fast_jit_cache[key] = fn
-        while len(self._fast_jit_cache) > self._fast_jit_cache_cap:
-            self._fast_jit_cache.popitem(last=False)
-        return fn
+        return self.cache_put(key, jax.jit(impl_eval if with_eval else run,
+                                           donate_argnums=(0, 1)))
 
     def _whole_args(self, entries):
         from examl_tpu.ops import pallas_whole
@@ -812,9 +835,9 @@ class LikelihoodEngine:
                 # drops scan-row scaler writes (JAX OOB scatter = drop)
                 # and candidate lnLs lose their scale counts.
                 grow = self.sev.num_rows - self.num_rows
-                spad = jnp.zeros((grow,) + self.scaler.shape[1:],
-                                 self.scaler.dtype)
-                self.scaler = jnp.concatenate([self.scaler, spad])
+                self.scaler = self._grow_rows(self.scaler, grow,
+                                              self.sharding and
+                                              self.sharding.scaler)
                 self.num_rows = self.sev.num_rows
             return base
         if not hasattr(self, "_scan_base"):
@@ -822,18 +845,27 @@ class LikelihoodEngine:
             self._scan_cap = 0
         if n > self._scan_cap:
             grow = _next_pow2(n) - self._scan_cap
-            pad = jnp.zeros((grow,) + self.clv.shape[1:], self.clv.dtype)
-            self.clv = jnp.concatenate([self.clv, pad])
-            spad = jnp.zeros((grow,) + self.scaler.shape[1:],
-                             self.scaler.dtype)
-            self.scaler = jnp.concatenate([self.scaler, spad])
+            self.clv = self._grow_rows(self.clv, grow,
+                                       self.sharding and self.sharding.clv)
+            self.scaler = self._grow_rows(self.scaler, grow,
+                                          self.sharding and
+                                          self.sharding.scaler)
             self._scan_cap += grow
             self.num_rows += grow
-            if self.sharding is not None:
-                self.clv = jax.device_put(self.clv, self.sharding.clv)
-                self.scaler = jax.device_put(self.scaler,
-                                             self.sharding.scaler)
         return self._scan_base
+
+    @staticmethod
+    def _grow_rows(arr, grow: int, sharding):
+        """Append `grow` zero rows, keeping the array committed to its
+        sharding: the pad is placed BEFORE the concatenate — eagerly
+        concatenating a committed global array with an uncommitted
+        process-local one is undefined in a multi-process run, and the
+        row axis is never the sharded axis so concat preserves the
+        operands' placement."""
+        pad = jnp.zeros((grow,) + arr.shape[1:], arr.dtype)
+        if sharding is not None:
+            pad = jax.device_put(pad, sharding)
+        return jnp.concatenate([arr, pad])
 
     def _scan_traversal_arrays(self, down_entries, up_entries, base: int):
         """Wave-schedule the orientation fixes AND the uppass entries into
@@ -944,9 +976,8 @@ class LikelihoodEngine:
 
     def _fast_fn(self, profile, with_eval: bool):
         key = (profile, with_eval)
-        fn = self._fast_jit_cache.get(key)
+        fn = self.cache_get(key)
         if fn is not None:
-            self._fast_jit_cache.move_to_end(key)
             return fn
         from examl_tpu.ops import fastpath
 
@@ -967,12 +998,8 @@ class LikelihoodEngine:
             return self._run_chunks_impl(dm, block_part, tips, clv, scaler,
                                          chunks)
 
-        fn = self._guard_first_call(
-            jax.jit(impl_eval if with_eval else impl, donate_argnums=(0, 1)))
-        self._fast_jit_cache[key] = fn
-        while len(self._fast_jit_cache) > self._fast_jit_cache_cap:
-            self._fast_jit_cache.popitem(last=False)
-        return fn
+        return self.cache_put(key, jax.jit(
+            impl_eval if with_eval else impl, donate_argnums=(0, 1)))
 
     # -- evaluation --------------------------------------------------------
 

@@ -228,7 +228,7 @@ def scan_program(eng, n_chunks: int):
     from examl_tpu.ops import kernels
 
     key = ("scan", n_chunks)
-    fn = eng._fast_jit_cache.get(key)
+    fn = eng.cache_get(key)
     if fn is not None:
         return fn
 
@@ -304,8 +304,7 @@ def scan_program(eng, n_chunks: int):
             (v["pool"], v["scaler"], REP), donate=(0, 1))
     else:
         fn = jax.jit(impl, donate_argnums=(0, 1))
-    eng._fast_jit_cache[key] = fn
-    return fn
+    return eng.cache_put(key, fn)
 
 
 # -- thorough arm -----------------------------------------------------------
@@ -339,7 +338,7 @@ def thorough_program(eng, n_chunks: int):
     from examl_tpu.ops import kernels
 
     key = ("thscan", n_chunks)
-    fn = eng._fast_jit_cache.get(key)
+    fn = eng.cache_get(key)
     if fn is not None:
         return fn
 
@@ -443,9 +442,7 @@ def thorough_program(eng, n_chunks: int):
                 jnp.stack([e1.reshape(-1), e2.reshape(-1),
                            e3.reshape(-1)], axis=1))
 
-    fn = jax.jit(impl, donate_argnums=(0, 1))
-    eng._fast_jit_cache[key] = fn
-    return fn
+    return eng.cache_put(key, jax.jit(impl, donate_argnums=(0, 1)))
 
 
 def run_plan_thorough(inst, tree: Tree, plan: ScanPlan

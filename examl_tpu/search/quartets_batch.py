@@ -55,7 +55,7 @@ def _program(eng, n_jobs: int):
     from examl_tpu.ops import kernels
 
     key = ("quartets", n_jobs)
-    fn = eng._fast_jit_cache.get(key)
+    fn = eng.cache_get(key)
     if fn is not None:
         return fn
 
@@ -131,9 +131,7 @@ def _program(eng, n_jobs: int):
         return jax.vmap(one_job, in_axes=(0, None, None, None, None))(
             codes, dm, block_part, weights, tips)
 
-    fn = jax.jit(impl)
-    eng._fast_jit_cache[key] = fn
-    return fn
+    return eng.cache_put(key, jax.jit(impl))
 
 
 def score_jobs(inst, jobs: Sequence[Tuple[int, int, int, int]]

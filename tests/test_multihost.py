@@ -222,7 +222,10 @@ def test_multihost_psr_rate_optimization():
     assert a1 > a0 + 100.0                 # categorization really helped
 
 
-SEV_CHILD = """
+# Shared preamble: distributed init + selective -S load (formatted with
+# repo/port/procid/bf, leaving {tree} for the test-specific tail).
+SEV_PREAMBLE = """
+import os; os.environ["EXAML_BATCH_SCAN"] = "1"
 import sys; sys.path.insert(0, {repo!r})
 import jax
 jax.distributed.initialize(coordinator_address="127.0.0.1:{port}",
@@ -233,10 +236,13 @@ from examl_tpu.parallel.sharding import default_site_sharding
 
 ndev = jax.device_count()
 sl = read_bytefile_for_process({bf!r}, {procid}, 2, block_multiple=ndev)
-print("local_patterns:", sum(p.width for p in sl.partitions))
 inst = PhyloInstance(sl, sharding=default_site_sharding(),
                      block_multiple=ndev, local_window=({procid}, 2),
                      save_memory=True)
+"""
+
+SEV_CHILD = SEV_PREAMBLE + """
+print("local_patterns:", sum(p.width for p in sl.partitions))
 tree = inst.tree_from_newick(open({tree!r}).read())
 lnl = float(inst.evaluate(tree, full=True))
 (eng,) = inst.engines.values()
@@ -246,23 +252,14 @@ print("alloc=", st["allocated_cells"], " dense=", st["dense_cells"])
 """
 
 
-def test_multihost_sev_selective_load(tmp_path):
-    """-S with per-process selective loading: each process reads only
-    its site columns, keeps gap bookkeeping for its own block window,
-    and the shard_mapped pooled programs reproduce the whole-read
-    single-process SEV lnL — the reference's -S under MPI with per-rank
-    reads (`axml.c:874-876`, `byteFile.c:278-382`)."""
-    import re
-
-    from examl_tpu.instance import PhyloInstance
+def _gappy_two_gene_bytefile(tmp_path, seed, ntaxa=16, gene=640):
+    """The shared -S multihost fixture: two gene blocks, each covered by
+    half the taxa (clade-structured gaps), written as a byteFile."""
+    from examl_tpu.io.alignment import build_alignment_data
     from examl_tpu.io.bytefile import write_bytefile
     from examl_tpu.io.partitions import parse_partition_file
-    from examl_tpu.io.alignment import build_alignment_data
 
-    # gappy two-gene alignment, wide enough for 2 procs x 4 devices
-    import numpy as np
-    rng = np.random.default_rng(8)
-    ntaxa, gene = 16, 640
+    rng = np.random.default_rng(seed)
     names = [f"t{i}" for i in range(ntaxa)]
     seqs = ["" for _ in range(ntaxa)]
     for g in range(2):
@@ -279,7 +276,73 @@ def test_multihost_sev_selective_load(tmp_path):
                                 specs=parse_partition_file(str(mp)))
     bf = str(tmp_path / "gappy.binary")
     write_bytefile(bf, data)
+    return data, bf
 
+
+SEV_SCAN_CHILD = SEV_PREAMBLE + """
+from examl_tpu.search import batchscan, spr
+
+tree = inst.tree_from_newick(open({tree!r}).read())
+inst.evaluate(tree, full=True)
+assert spr.batched_scan_enabled(inst)
+ctx = spr.SprContext(inst, thorough=False, do_cutoff=False)
+c = tree.centroid_branch()
+p = c if not tree.is_tip(c.number) else c.back
+q1, q2 = p.next.back, p.next.next.back
+spr.remove_node(inst, tree, ctx, p)
+plan = batchscan.plan_for_endpoints(inst, tree, p, q1, q2, 1, 4)
+assert plan is not None
+lnls = batchscan.run_plan(inst, tree, plan)
+print("scan_lnls=", ",".join("%.6f" % float(v) for v in lnls))
+"""
+
+
+def test_multihost_sev_batched_scan(tmp_path):
+    """The batched SPR radius scan under -S with 2 REAL processes: the
+    scan region is carved from the sharded pool and the DENSE scaler
+    must grow as a committed global array (engine.ensure_scan_rows /
+    _grow_rows — eager concat with a process-local pad is undefined
+    multi-process).  Candidate lnLs must agree across processes and
+    match the whole-read single-process SEV scan."""
+    from examl_tpu.instance import PhyloInstance
+    from examl_tpu.search import batchscan, spr
+
+    data, bf = _gappy_two_gene_bytefile(tmp_path, seed=21)
+    inst = PhyloInstance(data, save_memory=True)   # whole-read reference
+    tree = inst.random_tree(11)
+    treef = tmp_path / "t.nwk"
+    treef.write_text(tree.to_newick(data.taxon_names))
+    inst.evaluate(tree, full=True)
+    ctx = spr.SprContext(inst, thorough=False, do_cutoff=False)
+    c = tree.centroid_branch()
+    p = c if not tree.is_tip(c.number) else c.back
+    q1, q2 = p.next.back, p.next.next.back
+    spr.remove_node(inst, tree, ctx, p)
+    plan = batchscan.plan_for_endpoints(inst, tree, p, q1, q2, 1, 4)
+    assert plan is not None
+    ref = [float(v) for v in batchscan.run_plan(inst, tree, plan)]
+
+    port = _free_port()
+    outs = _launch(
+        [SEV_SCAN_CHILD.format(repo=REPO, port=port, procid=p_, bf=bf,
+                               tree=str(treef)) for p_ in range(2)],
+        ndev=4, timeout=900)
+    got = [[float(v) for v in
+            re.search(r"scan_lnls= (\S+)", out).group(1).split(",")]
+           for out in outs]
+    assert got[0] == got[1]
+    assert got[0] == pytest.approx(ref, abs=0.05)
+
+
+def test_multihost_sev_selective_load(tmp_path):
+    """-S with per-process selective loading: each process reads only
+    its site columns, keeps gap bookkeeping for its own block window,
+    and the shard_mapped pooled programs reproduce the whole-read
+    single-process SEV lnL — the reference's -S under MPI with per-rank
+    reads (`axml.c:874-876`, `byteFile.c:278-382`)."""
+    from examl_tpu.instance import PhyloInstance
+
+    data, bf = _gappy_two_gene_bytefile(tmp_path, seed=8)
     inst = PhyloInstance(data, save_memory=True)   # whole-read reference
     tree = inst.random_tree(11)
     treef = tmp_path / "t.nwk"
