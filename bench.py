@@ -76,9 +76,11 @@ FALLBACK_AVX_UPDATES_PER_SEC = 2.0e9
 # large configs therefore always measure the SCAN variant (the
 # best-variant hint only helps resumed workers); if a faster tier
 # proves itself on hardware, promote it by reordering here.
-TPU_PLAN = ["s-scan", "L:dna-large", "L:aa-large",
+TPU_PLAN = ["s-scan", "L:dna-large", "L:aa-large", "pallas-check",
             "s-chunks", "s-pallas", "s-whole", "prims"]
-CPU_PLAN = ["s-scan", "s-chunks", "prims"]
+# The CPU fallback also records one (small) large-config row so every
+# BENCH artifact carries compute-bound evidence tagged with its backend.
+CPU_PLAN = ["s-scan", "L:dna-mid", "s-chunks", "prims"]
 
 LARGE_CONFIGS = {
     # name: (ntaxa, patterns, datatype) — sized to keep the f32 CLV
@@ -86,6 +88,8 @@ LARGE_CONFIGS = {
     "dna-large": (140, 524_288, "DNA"),
     "aa-large": (140, 131_072, "AA"),
     "dna-1000": (1_000, 131_072, "DNA"),
+    # CPU-fallback-sized: compute-bound on a host core, ~1.2 GB f64.
+    "dna-mid": (140, 32_768, "DNA"),
 }
 
 
@@ -228,6 +232,27 @@ def _variant_step(eng, variant, entries):
     raise ValueError(f"unknown variant {variant!r}")
 
 
+def _bytes_per_traversal(entries, ntips: int, patterns: int, R: int,
+                         K: int, itemsize: int) -> int:
+    """HBM-traffic model for one dependency-chained traversal: per entry
+    one CLV row written, each non-tip child's CLV row read, scaler rows
+    alongside (int32/lane), tip children read 1-byte code rows.  P
+    matrices/tip tables are O(states^2) noise.  Paired with measured
+    wall time this yields achieved GB/s for the roofline comparison
+    (ROOFLINE.md: the 10x target = ~306 GB/s sustained)."""
+    clv_row = patterns * R * K * itemsize
+    sc_row = patterns * 4
+    total = 0
+    for e in entries:
+        total += clv_row + sc_row
+        for ch in (e.left, e.right):
+            if isinstance(ch, (int, np.integer)) and ch <= ntips:
+                total += patterns
+            else:
+                total += clv_row + sc_row
+    return total
+
+
 def _measure_variant(inst, tree, eng, entries, variant) -> dict:
     import jax
 
@@ -250,6 +275,10 @@ def _measure_variant(inst, tree, eng, entries, variant) -> dict:
         peak = float(os.environ.get("EXAML_PEAK_FLOPS", "1.97e14"))
     except ValueError:
         peak = 1.97e14
+    itemsize = np.dtype(getattr(eng, "storage_dtype", None)
+                        or eng.dtype).itemsize
+    bytes_per = _bytes_per_traversal(entries, eng.ntips, patterns,
+                                     eng.R, eng.K, itemsize)
     out = {
         "variant": variant,
         "ups": updates / dt,
@@ -258,6 +287,7 @@ def _measure_variant(inst, tree, eng, entries, variant) -> dict:
         "compile_s": round(compile_s, 1),
         "patterns": patterns,
         "dtype": str(np.dtype(eng.dtype)),
+        "gbps": round(n_steps * bytes_per / dt / 1e9, 2),
         "backend": jax.default_backend(),
     }
     if flops is not None:
@@ -318,6 +348,61 @@ def _stage_large(cfg: str, variant: str) -> dict:
         # cascade into config 2 by keeping the dead arena referenced).
 
 
+def _stage_pallas_check() -> dict:
+    """On-device Pallas correctness gate: run the fused chunk kernel and
+    the whole-traversal kernel through REAL Mosaic lowering (no
+    interpret) on a tiny instance and compare against the XLA fast path
+    — so the bench's Pallas tiers never race the chip with unvalidated
+    numerics.  (The CPU test battery can only exercise interpret mode;
+    round-4's first chip contact surfaced a Mosaic-only failure,
+    Precision.HIGH rejection.)"""
+    import jax
+    import jax.numpy as jnp
+
+    from examl_tpu.ops import fastpath, pallas_newview, pallas_whole
+
+    inst, tree = _synthetic_instance(30, 1024, "DNA", dtype=jnp.float32)
+    (eng,) = inst.engines.values()
+    _, entries = tree.full_traversal_centroid()
+    sched = eng._fast_schedule(entries)
+    ref_clv, ref_sc = fastpath.run_chunks(
+        eng.models, eng.block_part, eng.tips, jnp.array(eng.clv),
+        jnp.array(eng.scaler), sched.chunks, eng.scale_exp,
+        eng.fast_precision)
+    pal_clv, pal_sc = pallas_newview.run_chunks(
+        eng.models, eng.block_part, eng.tips, jnp.array(eng.clv),
+        jnp.array(eng.scaler), sched.chunks, eng.scale_exp,
+        precision=eng.pallas_precision, interpret=False)
+    ref_clv = np.asarray(ref_clv)
+    denom = np.maximum(np.abs(ref_clv), 1e-30)
+    chunk_rel = float(np.max(np.abs(np.asarray(pal_clv) - ref_clv)
+                             / denom))
+    sc_equal = bool(np.array_equal(np.asarray(ref_sc),
+                                   np.asarray(pal_sc)))
+
+    wsched = pallas_whole.build_flat(entries, eng.ntips,
+                                     eng.num_branch_slots)
+    w_clv, w_sc = pallas_whole.run_flat(
+        eng.models, eng.block_part, eng.tips, jnp.array(eng.clv),
+        jnp.array(eng.scaler), wsched, eng.scale_exp,
+        eng.pallas_precision, False)
+    w_clv, w_sc = np.asarray(w_clv), np.asarray(w_sc)
+    whole_rel, w_sc_equal = 0.0, True
+    for num, frow in sched.row_of.items():
+        wrow = wsched.row_of[num]
+        d = np.maximum(np.abs(ref_clv[frow]), 1e-30)
+        whole_rel = max(whole_rel, float(np.max(
+            np.abs(w_clv[wrow] - ref_clv[frow]) / d)))
+        w_sc_equal &= bool(np.array_equal(np.asarray(ref_sc)[frow],
+                                          w_sc[wrow]))
+    return {
+        "ok": sc_equal and w_sc_equal and chunk_rel < 1e-3
+        and whole_rel < 1e-3,
+        "chunk_rel": chunk_rel, "whole_rel": whole_rel,
+        "scalers_equal": sc_equal and w_sc_equal,
+    }
+
+
 def _stage_prims(state: _WorkerState) -> dict:
     """Per-call latency of the fused search primitives (partial
     traversal + root lnL; partial traversal + sumtable + full
@@ -369,6 +454,16 @@ def _stage_prims(state: _WorkerState) -> dict:
 def _worker(plan, best_hint: str) -> None:
     import jax
     jax.config.update("jax_enable_x64", True)
+    try:
+        # Durable compiles: a killed worker (stage deadline) must not
+        # forfeit the compile it paid for — the resumed worker reloads
+        # it from disk instead of re-racing the wedge-prone tunnel.
+        from examl_tpu.config import enable_persistent_compilation_cache
+        path = enable_persistent_compilation_cache()
+        if path:
+            sys.stderr.write(f"bench: compile cache at {path}\n")
+    except Exception as exc:                     # noqa: BLE001
+        sys.stderr.write(f"bench: compile cache unavailable: {exc}\n")
 
     state = _WorkerState()
     # best_hint is "variant" or "variant:ups" (a resumed worker must not
@@ -379,12 +474,19 @@ def _worker(plan, best_hint: str) -> None:
         best = (name, float(ups) if ups else 0.0)
     except ValueError:
         best = (name, 0.0)
+    pallas_invalid = False
     for i, sid in enumerate(plan):
         # The FIRST stage always runs — the primary metric must be
         # recorded even when probe retries ate the wall budget (the
         # parent decides whether spawning is worthwhile at all).
         if i > 0 and _elapsed() > _budget() - 15:
             print(f"##skip {sid} budget", flush=True)
+            continue
+        if pallas_invalid and sid in ("s-pallas", "s-whole"):
+            # The on-device correctness gate failed: numerically wrong
+            # tiers must not be timed at all — a fast-but-wrong kernel
+            # would win the headline metric and steer the large configs.
+            print(f"##skip {sid} pallas-check-failed", flush=True)
             continue
         print(f"##start {sid}", flush=True)
         try:
@@ -394,12 +496,17 @@ def _worker(plan, best_hint: str) -> None:
                     best = (r["variant"], r["ups"])
             elif sid.startswith("L:"):
                 r = _stage_large(sid[2:], best[0])
+            elif sid == "pallas-check":
+                r = _stage_pallas_check()
+                pallas_invalid = not r.get("ok", False)
             elif sid == "prims":
                 r = _stage_prims(state)
             else:
                 r = {"error": f"unknown stage {sid!r}"}
         except Exception as exc:                 # noqa: BLE001
             r = {"error": f"{type(exc).__name__}: {exc}"}
+            if sid == "pallas-check":
+                pallas_invalid = True     # couldn't validate = invalid
         r["stage"] = sid
         print(json.dumps(r), flush=True)
 
@@ -579,6 +686,7 @@ def _assemble(results: dict, notes: list, cpu_fallback: bool) -> str:
             "traversal_variant": win.get("variant"),
             "tflops_per_sec": win.get("tflops_per_sec"),
             "mfu": win.get("mfu"),
+            "achieved_gbps": win.get("gbps"),
         })
     else:
         doc.update({"value": 0.0, "vs_baseline": 0.0})
@@ -609,9 +717,18 @@ def _assemble(results: dict, notes: list, cpu_fallback: bool) -> str:
                 f"{pre}_ms_per_traversal": r.get("ms_per_traversal"),
                 f"{pre}_variant": r.get("variant"),
                 f"{pre}_tflops_per_sec": r.get("tflops_per_sec"),
-                f"{pre}_mfu": r.get("mfu")})
+                f"{pre}_mfu": r.get("mfu"),
+                f"{pre}_achieved_gbps": r.get("gbps")})
         else:
             doc[f"{pre}_error"] = r.get("error", "?")
+    # Pallas first-contact validation record (None = stage not run,
+    # e.g. CPU fallback; a dict with ok=false blocks trusting the
+    # Pallas tier numbers).
+    pc = results.get("pallas-check")
+    doc["pallas_validated"] = (pc.get("ok", False) if pc and "error"
+                               not in pc else None)
+    if pc and "error" in pc:
+        doc["pallas_check_error"] = pc["error"]
     # Secondary metrics: keys always present (null when the stage was
     # skipped/hung/failed) so consumers can index them unconditionally.
     for key in ("evaluate_ms", "newton_branch_ms",

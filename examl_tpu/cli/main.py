@@ -72,8 +72,10 @@ def build_argparser() -> argparse.ArgumentParser:
                     help="multifurcating constraint tree")
     ap.add_argument("-p", dest="seed", type=int, default=12345,
                     help="random seed (constraint-tree resolution)")
-    ap.add_argument("-Q", dest="quartet_file", default=None,
-                    help="quartet grouping file (-f q)")
+    ap.add_argument("-Y", "-Q", dest="quartet_file", default=None,
+                    help="quartet grouping file (-f q; the reference "
+                         "spells this -Y, axml.c:1063 — -Q kept as an "
+                         "alias for earlier revisions of this CLI)")
     ap.add_argument("-r", dest="quartet_samples", type=int, default=0,
                     help="number of random quartets to evaluate (-f q)")
     ap.add_argument("-I", dest="quartet_ckpt_interval", type=int,
@@ -461,7 +463,20 @@ def _packing_report(inst, files: RunFiles) -> None:
 
 
 def main(argv=None) -> int:
-    args = build_argparser().parse_args(argv)
+    ap = build_argparser()
+    args = ap.parse_args(argv)
+
+    # The reference's quartet flag-combination checks (axml.c:1206-1222):
+    # -Y and -r belong to -f q only, and are mutually exclusive.
+    if args.quartet_file and args.mode != "q":
+        ap.error('you must specify "-Y quartetGroupingFileName" in '
+                 'combination with "-f q"')
+    if args.quartet_samples > 0 and args.mode != "q":
+        ap.error('you must specify "-r randomQuartetNumber" in '
+                 'combination with "-f q"')
+    if args.quartet_samples > 0 and args.quartet_file:
+        ap.error('you must specify either "-r randomQuartetNumber" or '
+                 '"-Y quartetGroupingFileName"')
 
     from examl_tpu.instance import PhyloInstance
     from examl_tpu.parallel.launch import init_distributed, select_sharding
@@ -486,6 +501,10 @@ def main(argv=None) -> int:
                f"model: {args.model}")
 
     with files.phase("startup (io + engines)"):
+        from examl_tpu.config import enable_persistent_compilation_cache
+        cache = enable_persistent_compilation_cache()
+        if cache:
+            files.info(f"persistent compile cache: {cache}")
         sharding = select_sharding(args, args.save_memory, log=files.info)
         # Multi-process jobs read only their own site columns (the
         # reference's readMyData) — policy in selective_read_decision.

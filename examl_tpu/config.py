@@ -27,3 +27,48 @@ def enable_x64() -> None:
     engines (with the 2^-64 rescaling threshold) work without it.
     """
     jax.config.update("jax_enable_x64", True)
+
+
+def enable_persistent_compilation_cache(cache_dir: str | None = None):
+    """Turn on JAX's on-disk compilation cache, partitioned per backend
+    build string.
+
+    The reference pays its "compile" cost once at make time
+    (`Makefile.AVX.gcc`); this framework pays it per process at trace
+    time, and on the remote-compile TPU tunnel a single pathological
+    compile can block for minutes and a killed client wedges the
+    service.  A persistent cache makes compiles durable across process
+    kills and wedge windows, so a brief healthy window suffices to
+    bank every program.
+
+    The cache subdirectory embeds platform + platform_version (the
+    libtpu build string): after a backend upgrade the old entries
+    become unreachable rather than a version-mismatch hazard.  Set
+    EXAML_COMPILE_CACHE=0 to disable, or to a path to relocate.
+
+    Returns the cache path, or None when disabled/unavailable.
+    """
+    import hashlib
+    import os
+    import re
+
+    env = os.environ.get("EXAML_COMPILE_CACHE")
+    if env == "0":
+        return None
+    root = cache_dir or env or os.path.expanduser("~/.cache/examl_tpu/xla")
+    try:
+        dev = jax.devices()[0]      # forces backend init; may raise
+        key = "%s-%s" % (dev.platform,
+                         getattr(dev.client, "platform_version", "?"))
+    except Exception:               # no usable backend: nothing to cache
+        return None
+    sub = re.sub(r"[^A-Za-z0-9._-]+", "_", key)[:60]
+    path = os.path.join(
+        root, f"{sub}-{hashlib.sha1(key.encode()).hexdigest()[:10]}")
+    os.makedirs(path, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", path)
+    # Cache every nontrivial compile: the tunnel makes even mid-sized
+    # programs expensive to lose (default threshold is 1s of compile).
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    return path

@@ -949,12 +949,13 @@ class LikelihoodEngine:
     def batched_thorough(self, plan):
         """Thorough-arm companion of `batched_scan`: triangle Newton,
         localSmooth, and scoring per candidate in one dispatch; returns
-        (lnls [N], smoothed branch triplets [N, 3]).  Dense arenas only
-        (spr.thorough_batched_ok gates -S to the sequential thorough
-        primitives)."""
+        (lnls [N], smoothed branch triplets [N, 3]).  Works on the dense
+        arena and on -S SEV pools (sharded or not) alike, like the lazy
+        arm."""
         from examl_tpu.search import batchscan
 
-        assert not self.save_memory, "batched thorough arm is dense-only"
+        if self.save_memory:
+            self.sev.update_for_entries(plan.down_entries)
         base = self.ensure_scan_rows(len(plan.up_entries))
         tv = self._scan_traversal_arrays(plan.down_entries,
                                          plan.up_entries, base)
@@ -964,13 +965,15 @@ class LikelihoodEngine:
         for i, c in enumerate(plan.candidates):
             zq0[i] = float(np.asarray(c.q_slot.z, np.float64)[0])
         fn = batchscan.thorough_program(self, n_chunks)
-        self.clv, self.scaler, lnls, es = fn(
-            self.clv, self.scaler, tv,
+        buf, aux = self._state()
+        buf, self.scaler, lnls, es = fn(
+            buf, self.scaler, aux, tv,
             jnp.asarray(qg.reshape(n_chunks, T)),
             jnp.asarray(upg.reshape(n_chunks, T)),
             jnp.asarray(zq0.reshape(n_chunks, T), dtype=self.dtype),
             jnp.int32(self._gidx(plan.s_num)), self.models,
             self.block_part, self.weights, self.tips)
+        self._set_buf(buf)
         N = len(plan.candidates)
         return np.asarray(lnls)[:N], np.asarray(es)[:N]
 

@@ -57,7 +57,9 @@ engine._build_sev_mapped_programs; explicit lnL/derivative psums via
 the kernels' axis_name; the batched SPR scan maps the same way
 (search/batchscan.py scan_program, candidate lnLs psummed); equivalence
 tests tests/test_sev.py::test_sev_sharded_*.  The batched THOROUGH arm
-stays dense-only, as on single-device -S.
+maps the same way (batchscan.thorough_program: per-NR-iteration
+derivative psums inside the on-device Newton loops, one final lnL
+psum).
 """
 
 from __future__ import annotations

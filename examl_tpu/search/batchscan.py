@@ -331,6 +331,13 @@ def thorough_program(eng, n_chunks: int):
 
     Newton derivatives are invariant to the operands' scaling counters
     (a per-site constant factor), so only the final lnL applies them.
+
+    Like the lazy arm, the traversal and CLV gathers go through the
+    engine's state-agnostic primitives, so the same program text serves
+    the dense arena and the -S SEV pool; under SEV x sharding it
+    shard_maps with per-NR-iteration derivative psums (the reference's
+    per-iteration Allreduce, `makenewzGenericSpecial.c:1241-1248`) and
+    one final lnL psum.
     """
     import jax
     import jax.numpy as jnp
@@ -349,11 +356,11 @@ def thorough_program(eng, n_chunks: int):
     ntips = eng.ntips
     lzmax = float(np.log(ZMAX))
 
-    def impl(clv, scaler, tv, qg, upg, zq0, sg, dm, block_part, weights,
-             tips):
-        clv, scaler = kernels.traverse(dm, block_part, tips, clv, scaler,
-                                       tv, scale_exp, ntips, None)
-        xs, ss = kernels.gather_child(tips, clv, scaler, sg, ntips)
+    def impl(clv, scaler, aux, tv, qg, upg, zq0, sg, dm, block_part,
+             weights, tips):
+        clv, scaler = eng._traverse_kernel(clv, aux, scaler, tv, dm,
+                                           block_part, tips, None)
+        xs, ss = eng._gather(clv, aux, scaler, sg, tips)
         cdt = tips.table.dtype        # compute dtype (arena may store bf16)
         minlik, two_e, _ = kernels.scale_constants(cdt, scale_exp)
         acc = kernels._acc_dtype(cdt)
@@ -368,7 +375,8 @@ def thorough_program(eng, n_chunks: int):
             return kernels.newton_raphson_branch(
                 dm, block_part, weights, st,
                 jnp.full(1, z0, dtype=cdt),
-                jnp.full(1, iters, jnp.int32), jnp.zeros(1, bool), 1)[0]
+                jnp.full(1, iters, jnp.int32), jnp.zeros(1, bool), 1,
+                axis_name=eng._axis_name)[0]
 
         def one(xq1, sq1, xr1, sr1, z01):
             zqr = nr(xq1, xr1, z01, SPR_NR_ITERATIONS)
@@ -432,17 +440,32 @@ def thorough_program(eng, n_chunks: int):
 
         def chunk(carry, args):
             qg_c, upg_c, z0_c = args
-            xq, sq = kernels.gather_child(tips, clv, scaler, qg_c, ntips)
-            xr, sr = kernels.gather_child(tips, clv, scaler, upg_c, ntips)
+            xq, sq = eng._gather(clv, aux, scaler, qg_c, tips)
+            xr, sr = eng._gather(clv, aux, scaler, upg_c, tips)
             lnl, e1, e2, e3 = jax.vmap(one)(xq, sq, xr, sr, z0_c)
             return carry, (lnl, e1, e2, e3)
 
         _, (lnls, e1, e2, e3) = jax.lax.scan(chunk, 0, (qg, upg, zq0))
+        if eng._axis_name is not None:
+            # SEV x sharding: the branch triplets are already globally
+            # agreed (every NR iteration psums its derivatives); only
+            # the final per-candidate lnLs need the one Allreduce.
+            lnls = jax.lax.psum(lnls, eng._axis_name)
         return (clv, scaler, lnls.reshape(-1),
                 jnp.stack([e1.reshape(-1), e2.reshape(-1),
                            e3.reshape(-1)], axis=1))
 
-    return eng.cache_put(key, jax.jit(impl, donate_argnums=(0, 1)))
+    if eng._axis_name is not None:
+        v = eng._sev_spec_vocab()
+        REP = v["rep"]
+        fn = v["wrap"](
+            impl,
+            (v["pool"], v["scaler"], v["aux"], v["traversal"], REP, REP,
+             REP, REP, v["models"], v["blocks"], v["sites"], v["tips"]),
+            (v["pool"], v["scaler"], REP, REP), donate=(0, 1))
+    else:
+        fn = jax.jit(impl, donate_argnums=(0, 1))
+    return eng.cache_put(key, fn)
 
 
 def run_plan_thorough(inst, tree: Tree, plan: ScanPlan

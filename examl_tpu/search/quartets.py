@@ -10,7 +10,7 @@ full tree structure, reusing two inner nodes as the quartet's internal
 edge (the remaining nodes stay dangling, exactly as the reference does).
 
 Supports the reference's three flavors: all quartets, random subsampling
-(-r), and grouped quartets (-Q file with four parenthesized taxon sets),
+(-r), and grouped quartets (-Y file with four parenthesized taxon sets),
 with periodic checkpointing every `checkpoint_interval` quartets.
 """
 

@@ -417,18 +417,6 @@ def _on_accelerator(inst: PhyloInstance) -> bool:
     return True
 
 
-def _scan_structurally_ok(inst: PhyloInstance) -> bool:
-    """Hard constraints of the batched THOROUGH arm: its on-device
-    triangle/smoothing Newton programs are dense-only (-S keeps the
-    sequential thorough primitives); EXAML_BATCH_SCAN=0 forces
-    sequential primitives everywhere."""
-    import os
-    if os.environ.get("EXAML_BATCH_SCAN") == "0":
-        return False
-    return not any(getattr(e, "save_memory", False)
-                   for e in inst.engines.values())
-
-
 def rearrange_batched(inst: PhyloInstance, tree: Tree, ctx: SprContext,
                       p: Node, mintrav: int, maxtrav: int,
                       thorough: bool = False) -> bool:
@@ -520,29 +508,30 @@ def rearrange_batched(inst: PhyloInstance, tree: Tree, ctx: SprContext,
     return True
 
 
-
 def thorough_batched_ok(inst: PhyloInstance) -> bool:
-    """The batched thorough arm additionally needs ONE state bucket and
-    ONE branch slot: the triangle/smoothing Newton loops iterate on
-    device, so mixed buckets (whose derivatives must sum across engines
-    per iteration) and per-partition branch masks keep the sequential
+    """The batched thorough arm needs ONE state bucket and ONE branch
+    slot: the triangle/smoothing Newton loops iterate on device, so
+    mixed buckets (whose derivatives must sum across engines per
+    iteration) and per-partition branch masks keep the sequential
     primitives; PSR keeps the sequential thorough arm too (the batched
-    triangle/smoothing uses the GAMMA P-matrix form).
+    triangle/smoothing uses the GAMMA P-matrix form).  -S SEV pools are
+    supported like the lazy arm (the program goes through the engine's
+    state-agnostic primitives and shard_maps under SEV x sharding).
 
     It is also gated to ACCELERATOR devices: it trades compute (the
     whole window, no cutoff early-outs) for dispatches, which wins where
     dispatch latency dominates (the TPU tunnel) and loses on host CPU,
-    where the sequential cutoff arm is cheaper.  EXAML_BATCH_THOROUGH=0
-    forces it off anywhere; =1 forces it on WHERE THE STRUCTURAL
-    REQUIREMENTS HOLD (one bucket, one slot, no PSR/-S) -- those are
-    hard constraints of the on-device Newton loops, not preferences.
+    where the sequential cutoff arm is cheaper.  EXAML_BATCH_SCAN=0 or
+    EXAML_BATCH_THOROUGH=0 force it off anywhere; =1 forces it on WHERE
+    THE STRUCTURAL REQUIREMENTS HOLD (one bucket, one slot, no PSR) --
+    those are hard constraints of the on-device Newton loops, not
+    preferences.
     """
     import os
     forced = os.environ.get("EXAML_BATCH_THOROUGH")
-    if forced == "0":
+    if forced == "0" or os.environ.get("EXAML_BATCH_SCAN") == "0":
         return False
-    if not (_scan_structurally_ok(inst) and len(inst.engines) == 1
-            and inst.num_branch_slots == 1
+    if not (len(inst.engines) == 1 and inst.num_branch_slots == 1
             and not getattr(inst, "psr", False)):
         return False
     if forced == "1":
@@ -563,11 +552,11 @@ def rearrange_auto(inst: PhyloInstance, tree: Tree, ctx: SprContext,
     """Dispatch-latency-aware rearrange: one device program per pruned
     node for both arms.  The lazy scan batches for GAMMA and PSR alike;
     the thorough arm batches on accelerator devices for single-bucket,
-    single-slot GAMMA instances (thorough_batched_ok).  Sequential
-    primitives remain for the -S THOROUGH arm (the batched lazy scan
-    works on SEV pools), for mixed state buckets and per-partition
-    branches (the on-device Newton loops cannot sum derivatives across
-    engines), and wherever the env switches force them."""
+    single-slot GAMMA instances (thorough_batched_ok), dense or -S.
+    Sequential primitives remain for mixed state buckets and
+    per-partition branches (the on-device Newton loops cannot sum
+    derivatives across engines), and wherever the env switches force
+    them."""
     if ctx.thorough:
         if thorough_batched_ok(inst):
             return rearrange_batched_thorough(inst, tree, ctx, p,

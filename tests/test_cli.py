@@ -37,6 +37,29 @@ def test_driver_flags_parse():
         ap.parse_args(["-s", "x", "-n", "R", "-f", "z"])
 
 
+def test_quartet_flag_combinations(tmp_path):
+    """-Y is the reference's quartet-grouping flag (axml.c:1063; -Q kept
+    as an alias), and the reference's -f q flag-combination errors
+    (axml.c:1206-1222) are enforced before any data is read."""
+    from examl_tpu.cli.main import main as run_main
+
+    ap = build_argparser()
+    args = ap.parse_args(["-s", "x.binary", "-n", "R", "-f", "q",
+                          "-Y", "groups.txt", "-t", "t.nwk"])
+    assert args.quartet_file == "groups.txt"
+    args = ap.parse_args(["-s", "x.binary", "-n", "R", "-f", "q",
+                          "-Q", "groups.txt", "-t", "t.nwk"])
+    assert args.quartet_file == "groups.txt"          # legacy alias
+
+    base = ["-s", "x.binary", "-n", "R", "-t", "t.nwk", "-w",
+            str(tmp_path)]
+    for bad in (base + ["-f", "d", "-Y", "g.txt"],      # -Y needs -f q
+                base + ["-f", "e", "-r", "100"],        # -r needs -f q
+                base + ["-f", "q", "-Y", "g.txt", "-r", "100"]):  # excl
+        with pytest.raises(SystemExit):
+            run_main(bad)
+
+
 @pytest.mark.slow
 def test_driver_search_end_to_end(tmp_path):
     """Tiny full -f d run through the CLI: result + log + model files."""

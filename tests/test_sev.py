@@ -351,3 +351,70 @@ def test_sev_sharded_batched_scan_matches_single(monkeypatch):
     (b1, e1), (b2, e2) = outcomes
     assert b1 == pytest.approx(b2, abs=1e-8)
     assert e1 == pytest.approx(e2, abs=1e-8)
+
+
+@pytest.mark.slow
+def test_sev_batched_thorough_matches_dense(monkeypatch):
+    """The batched THOROUGH arm (triangle Newton + localSmooth + score,
+    one dispatch) on an -S SEV pool must reproduce the dense arena's
+    per-candidate lnLs and smoothed branch triplets."""
+    from examl_tpu.search import batchscan, spr
+
+    monkeypatch.setenv("EXAML_BATCH_THOROUGH", "1")
+    import tempfile
+    ad = _small_gappy_ad(tempfile.mkdtemp())
+    results = {}
+    for save in (False, True):
+        inst = PhyloInstance(ad, save_memory=save)
+        assert spr.thorough_batched_ok(inst)
+        tree = inst.random_tree(3)
+        inst.evaluate(tree, full=True)
+        ctx = spr.SprContext(inst, thorough=True, do_cutoff=False)
+        c = tree.centroid_branch()
+        p = c if not tree.is_tip(c.number) else c.back
+        q1, q2 = p.next.back, p.next.next.back
+        p1z, p2z = list(q1.z), list(q2.z)
+        spr.remove_node(inst, tree, ctx, p)
+        plan = batchscan.plan_for_endpoints(inst, tree, p, q1, q2, 1, 4)
+        assert plan is not None and plan.candidates
+        results[save] = batchscan.run_plan_thorough(inst, tree, plan)
+        hookup(p.next, q1, p1z)
+        hookup(p.next.next, q2, p2z)
+        inst.new_view(tree, p)
+    np.testing.assert_allclose(results[True][0], results[False][0],
+                               rtol=1e-10, atol=1e-6)
+    np.testing.assert_allclose(results[True][1], results[False][1],
+                               rtol=1e-10, atol=1e-9)
+
+
+@pytest.mark.slow
+def test_sev_sharded_batched_thorough_matches_single(monkeypatch):
+    """The shard_mapped batched thorough arm (per-NR-iteration
+    derivative psums, one final lnL psum) must reproduce the
+    single-device SEV thorough scores and branch triplets."""
+    from examl_tpu.parallel.sharding import default_site_sharding
+    from examl_tpu.search import batchscan, spr
+
+    monkeypatch.setenv("EXAML_BATCH_THOROUGH", "1")
+    import tempfile
+    ad = _small_gappy_ad(tempfile.mkdtemp())
+    sh = default_site_sharding(8)
+    results = []
+    for sharding in (None, sh):
+        inst = PhyloInstance(ad, save_memory=True, sharding=sharding,
+                             block_multiple=8)
+        assert spr.thorough_batched_ok(inst)
+        tree = inst.random_tree(3)
+        inst.evaluate(tree, full=True)
+        ctx = spr.SprContext(inst, thorough=True, do_cutoff=False)
+        c = tree.centroid_branch()
+        p = c if not tree.is_tip(c.number) else c.back
+        q1, q2 = p.next.back, p.next.next.back
+        spr.remove_node(inst, tree, ctx, p)
+        plan = batchscan.plan_for_endpoints(inst, tree, p, q1, q2, 1, 3)
+        assert plan is not None and plan.candidates
+        results.append(batchscan.run_plan_thorough(inst, tree, plan))
+    np.testing.assert_allclose(results[1][0], results[0][0],
+                               rtol=1e-10, atol=1e-6)
+    np.testing.assert_allclose(results[1][1], results[0][1],
+                               rtol=1e-10, atol=1e-9)

@@ -17,6 +17,12 @@ import numpy as np
 
 jax.config.update("jax_enable_x64", True)
 
+from examl_tpu.config import enable_persistent_compilation_cache
+
+_cache = enable_persistent_compilation_cache()
+if _cache:
+    print(f"perf_lab: compile cache at {_cache}")
+
 from examl_tpu.instance import default_instance
 from examl_tpu.ops import kernels
 from examl_tpu.tree.topology import Tree
