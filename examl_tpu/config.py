@@ -60,15 +60,20 @@ def enable_persistent_compilation_cache(cache_dir: str | None = None):
         dev = jax.devices()[0]      # forces backend init; may raise
         key = "%s-%s" % (dev.platform,
                          getattr(dev.client, "platform_version", "?"))
-    except Exception:               # no usable backend: nothing to cache
+        sub = re.sub(r"[^A-Za-z0-9._-]+", "_", key)[:60]
+        path = os.path.join(
+            root, f"{sub}-{hashlib.sha1(key.encode()).hexdigest()[:10]}")
+        os.makedirs(path, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", path)
+        # Cache every nontrivial compile: the tunnel makes even
+        # mid-sized programs expensive to lose (default threshold is
+        # 1s of compile).
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          0.5)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+        return path
+    except Exception:
+        # No usable backend, or the cache root is unwritable (HOME
+        # unset / read-only / quota): run without a cache — a missing
+        # optimization must never abort startup or test collection.
         return None
-    sub = re.sub(r"[^A-Za-z0-9._-]+", "_", key)[:60]
-    path = os.path.join(
-        root, f"{sub}-{hashlib.sha1(key.encode()).hexdigest()[:10]}")
-    os.makedirs(path, exist_ok=True)
-    jax.config.update("jax_compilation_cache_dir", path)
-    # Cache every nontrivial compile: the tunnel makes even mid-sized
-    # programs expensive to lose (default threshold is 1s of compile).
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
-    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
-    return path

@@ -204,7 +204,16 @@ class PhyloInstance:
         must finish with an unrestricted evaluate before changing topology.
         """
         if p is None:
-            p = tree.start
+            # Full traversals root at the topological centroid, not the
+            # reference's tr->start tip edge: lnL is rooting-invariant,
+            # but the centroid halves the wave-schedule depth (fewer
+            # sequential newview steps on device) AND maximizes -S
+            # savings — subtree windows stay small on BOTH sides, so
+            # far more (node, block) cells are all-gap (measured
+            # tools/sev_ratio.py: 57% vs 34% block cells saved on the
+            # clade-structured fixture; the reference's own per-site
+            # compaction at its tip rooting saves 49%).
+            p = tree.centroid_branch() if full else tree.start
         q = p.back
         if full:
             tree.invalidate_all()

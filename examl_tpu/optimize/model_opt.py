@@ -268,6 +268,15 @@ def mod_opt(inst: PhyloInstance, tree: Tree, likelihood_epsilon: float,
                 optimize_rate_categories(inst, tree)
                 inst.cat_opt_rounds += 1
                 dbg("after cat-opt")
+            else:
+                # Rounds beyond the reference's 3: its CAT branch does
+                # nothing more for rate heterogeneity; we polish the
+                # frozen categorization's representative rates as free
+                # continuous parameters (accept-if-better; the PSR
+                # analogue of the GAMMA branch's alpha Brent).
+                from examl_tpu.optimize.psr import refine_category_rates
+                refine_category_rates(inst, tree)
+                dbg("after cat-refine")
         else:
             opt_alphas(inst, tree)
             opt_lg4x(inst, tree)

@@ -227,6 +227,101 @@ def _normalize_mean_rate(inst: PhyloInstance) -> None:
     # testData/49 PSR.
 
 
+def refine_category_rates(inst: PhyloInstance, tree: Tree,
+                          tol: float = 0.0001) -> float:
+    """Continuous polish of a frozen categorization — an extension
+    beyond the reference, run in mod_opt rounds after the reference's 3
+    scan/categorize rounds are exhausted (where its CAT branch does
+    nothing further for rate heterogeneity).
+
+    The reference pins each category's rate to the lattice value the
+    per-site crawl happened to land on (`categorizePartition` copies
+    `rc[k].rate`, `optimizeModel.c:1784-1788`); the lattice resolution
+    then bounds the reachable (GTR rates x branch lengths) basin.  Here
+    each representative rate is a free continuous parameter: Brent each
+    category index across partitions (batched, accept-if-better per
+    partition), then restore the weighted-mean-rate-1 convention
+    EXACTLY via rates /= m and z -> z**m — lnL depends on the product
+    rate*log(z) only (`makeP`'s EIGN*r*log z), so the joint rescale is
+    invariant, not just approximate.
+
+    Measured on testData/49 PSR -f e: endpoint -14710.8 -> -14662.5 vs
+    the reference's -14702.97 (the lattice-frozen optimizers stall ~8
+    lnL apart; the continuous polish beats both).  EXAML_PSR_REFINE=0
+    restores the reference's exact stop-at-the-lattice behavior.
+    """
+    import os
+
+    from examl_tpu.optimize.brent import minimize_vector
+    from examl_tpu.constants import ZMAX, ZMIN
+    from examl_tpu.tree.topology import hookup
+
+    assert inst.psr
+    if os.environ.get("EXAML_PSR_REFINE") == "0":
+        return inst.evaluate(tree, full=True)
+    inst.evaluate(tree, full=True)
+    # Accepted-state lnL per partition, maintained incrementally: after
+    # each category's accept/restore the accepted value is known from
+    # the Brent result, so no re-evaluate per category is needed (the
+    # next category's bracket starts from the accepted state anyway).
+    cur = [float(v) for v in inst.per_partition_lnl]
+    ncat_max = max(len(r) for r in inst.per_site_rates)
+    for k in range(ncat_max):
+        gids = [g for g in range(inst.num_parts)
+                if len(inst.per_site_rates[g]) > k]
+        if not gids:
+            continue
+        x0 = np.array([float(inst.per_site_rates[g][k]) for g in gids])
+        start = np.array([cur[g] for g in gids])
+
+        def fn(xs: np.ndarray) -> np.ndarray:
+            for g, v in zip(gids, xs):
+                inst.per_site_rates[g][k] = float(v)
+            inst.push_site_rates()
+            inst.evaluate(tree, full=True)
+            return -np.array([float(inst.per_partition_lnl[g])
+                              for g in gids])
+
+        xb, fb = minimize_vector(x0, np.full(len(gids), MIN_RATE),
+                                 np.full(len(gids), 32.0), fn, tol)
+        for g, v0, v1, f1, l0 in zip(gids, x0, xb, fb, start):
+            accept = -f1 > l0
+            inst.per_site_rates[g][k] = float(v1 if accept else v0)
+            cur[g] = float(-f1) if accept else float(l0)
+        inst.push_site_rates()
+    # Exact mean-rate-1 restoration (see docstring): globally with one
+    # exponent, or per partition under -M (each partition's branch
+    # slot compensates with its own partition's exponent, preserving
+    # the reference's per-partition convention, `updatePerSiteRates`
+    # numBranches>1 arm).  Clipping at ZMIN/ZMAX breaks exactness only
+    # for branches already pinned at the bounds, where the reference
+    # clips identically.
+    parts = inst.alignment.partitions
+    C = inst.num_branch_slots
+    if C > 1:
+        mexp = np.ones(C)
+        for gid, part in enumerate(parts):
+            rates = inst.per_site_rates[gid][inst.rate_category[gid]]
+            m = float(part.weights @ rates) / float(part.weights.sum())
+            inst.per_site_rates[gid] = inst.per_site_rates[gid] / m
+            mexp[gid] = m
+    else:
+        num = den = 0.0
+        for gid, part in enumerate(parts):
+            rates = inst.per_site_rates[gid][inst.rate_category[gid]]
+            num += float(part.weights @ rates)
+            den += float(part.weights.sum())
+        mexp = np.full(1, num / den)
+        for gid in range(inst.num_parts):
+            inst.per_site_rates[gid] = inst.per_site_rates[gid] / mexp[0]
+    inst.push_site_rates()
+    for a, b in tree.all_branches():
+        z = np.clip(np.power(np.asarray(a.z, np.float64), mexp),
+                    ZMIN, ZMAX)
+        hookup(a, b, z.tolist())
+    return inst.evaluate(tree, full=True)
+
+
 def optimize_rate_categories(inst: PhyloInstance, tree: Tree,
                              max_categories: int | None = None) -> float:
     """One CAT optimization round: scan, categorize, normalize, accept if

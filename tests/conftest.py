@@ -22,6 +22,15 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
 
+# Persistent XLA compile cache: the sharded/SEV batteries build many
+# engine instances whose per-instance jit closures lower to identical
+# HLO — the disk cache (keyed on HLO + backend build) shares compiles
+# across instances AND across pytest runs, cutting the slow tiers'
+# wall time.  EXAML_COMPILE_CACHE=0 disables.
+from examl_tpu.config import enable_persistent_compilation_cache  # noqa: E402
+
+enable_persistent_compilation_cache()
+
 import pytest  # noqa: E402,F401
 
 TESTDATA = "/root/reference/testData"

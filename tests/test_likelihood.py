@@ -87,12 +87,18 @@ def test_scaling_deep_tree():
     tree = inst.random_tree(seed=1)
     for slot, _ in tree.all_branches():
         slot.z[0] = 0.05   # long branches -> rapid CLV decay
-    lnl0 = inst.evaluate(tree, full=True)
+    # Evaluate from a tip edge (maximum traversal depth): full=True
+    # with p=None roots at the centroid, whose halved depth can stay
+    # under the 2^-256 threshold — this test needs the deep rooting.
+    lnl0 = inst.evaluate(tree, tree.start, full=True)
     assert np.isfinite(lnl0) and lnl0 < 0
     total_scale = int(np.asarray(inst.engines[4].scaler).sum())
     assert total_scale > 0, "expected rescaling to trigger"
     lnl1 = inst.evaluate(tree, tree.all_branches()[40][0], full=True)
     assert abs(lnl0 - lnl1) < 1e-7 * abs(lnl0), (lnl0, lnl1)
+    # and the centroid rooting agrees too
+    lnl2 = inst.evaluate(tree, full=True)
+    assert abs(lnl0 - lnl2) < 1e-7 * abs(lnl0), (lnl0, lnl2)
 
 
 def test_makenewz_improves_lnl(data49, tree49_text):

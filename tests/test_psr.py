@@ -132,6 +132,74 @@ def test_psr_optimization_round_improves_and_normalizes():
 
 
 @pytest.mark.slow
+def test_refine_category_rates_improves_and_stays_normalized():
+    """The continuous category-rate polish (optimize.psr.
+    refine_category_rates, beyond-reference extension): lnL never
+    drops, the weighted mean rate stays exactly 1, and the rates/=m,
+    z->z**m rescale is lnL-invariant."""
+    from examl_tpu.optimize.branch import tree_evaluate
+    from examl_tpu.optimize.psr import refine_category_rates
+
+    inst = PhyloInstance(_dna(seed=13), rate_model="PSR")
+    tree = inst.random_tree(seed=2)
+    tree_evaluate(inst, tree, 1.0)
+    inst.evaluate(tree, full=True)
+    lnl1 = optimize_rate_categories(inst, tree, max_categories=8)
+    lnl2 = refine_category_rates(inst, tree)
+    assert lnl2 >= lnl1 - 1e-9
+    part = inst.alignment.partitions[0]
+    cat_rates = inst.per_site_rates[0][inst.rate_category[0]]
+    mean = float(part.weights @ cat_rates) / float(part.weights.sum())
+    assert mean == pytest.approx(1.0, abs=1e-9)
+    # invariance of the rescale: a fresh full evaluate reproduces the
+    # returned lnL (the rescale happened inside refine)
+    assert inst.evaluate(tree, full=True) == pytest.approx(lnl2,
+                                                           abs=1e-6)
+
+
+@pytest.mark.slow
+def test_refine_category_rates_per_partition_branches():
+    """Under -M the refinement must keep EACH partition's weighted mean
+    rate at 1 (the reference's updatePerSiteRates numBranches>1 arm),
+    compensating each partition's branch slot with its own exponent."""
+    from examl_tpu.io.partitions import parse_partition_file
+    from examl_tpu.optimize.branch import tree_evaluate
+    from examl_tpu.optimize.psr import (optimize_rate_categories,
+                                        refine_category_rates)
+    import tempfile, os
+
+    rng = np.random.default_rng(17)
+    n, gene = 10, 240
+    names = [f"t{i}" for i in range(n)]
+    cur = rng.integers(0, 4, 2 * gene)
+    seqs = []
+    for _ in range(n):
+        flip = rng.random(2 * gene) < 0.2
+        cur = np.where(flip, rng.integers(0, 4, 2 * gene), cur)
+        seqs.append("".join("ACGT"[c] for c in cur))
+    mp = os.path.join(tempfile.mkdtemp(), "p.model")
+    with open(mp, "w") as f:
+        f.write(f"DNA, g1 = 1-{gene}\nDNA, g2 = {gene+1}-{2*gene}\n")
+    from examl_tpu.io.alignment import build_alignment_data
+    data = build_alignment_data(names, seqs,
+                                specs=parse_partition_file(mp))
+    inst = PhyloInstance(data, rate_model="PSR",
+                         per_partition_branches=True)
+    tree = inst.random_tree(3)
+    tree_evaluate(inst, tree, 1.0)
+    inst.evaluate(tree, full=True)
+    l1 = optimize_rate_categories(inst, tree, max_categories=8)
+    l2 = refine_category_rates(inst, tree)
+    assert l2 >= l1 - 1e-9
+    for gid, part in enumerate(inst.alignment.partitions):
+        rates = inst.per_site_rates[gid][inst.rate_category[gid]]
+        mean = float(part.weights @ rates) / float(part.weights.sum())
+        assert mean == pytest.approx(1.0, abs=1e-9), (gid, mean)
+    # invariance: fresh full evaluate reproduces the returned lnL
+    assert inst.evaluate(tree, full=True) == pytest.approx(l2, abs=1e-6)
+
+
+@pytest.mark.slow
 def test_psr_mod_opt_on_49(psr49=None):
     """modOpt under PSR on the 49-taxon fixture improves lnL and caps
     categories at the default 25."""

@@ -88,16 +88,17 @@ def test_psr_endpoint_matches_reference():
     inst.evaluate(tree, full=True)
     tree_evaluate(inst, tree, 1.0)
     mod_opt(inst, tree, 0.1)
-    # Measured endpoints: ours -14710.82 vs reference -14702.97 (cat-opt
-    # rounds -15805/-14881/-14772 vs -15860/-14903/-14776; both then
-    # grind ~30 GTR-rate+branch rounds to the same 0.1-lnL convergence
-    # rule — EXAML_DEBUG_MODOPT=1 prints the phase trail to diff against
-    # a -D_DEBUG_MOD_OPT reference build).  The residual ~8 lnL is two
-    # nearby optima of the per-site-rate lattice, not a pipeline gap:
-    # round-1 'after rates' already differs (+25.8 in our favor) because
-    # the vectorized GTR Brent converges tighter than the reference's.
-    assert inst.likelihood == pytest.approx(_fixture_lnl("ref49psr"),
-                                            abs=10.0)
+    # History: lattice-frozen optimizers stall ~8 lnL apart (ours
+    # -14710.82 vs reference -14702.97; cat-opt rounds -15805/-14881/
+    # -14772 vs -15860/-14903/-14776 — EXAML_DEBUG_MODOPT=1 prints the
+    # phase trail to diff against a -D_DEBUG_MOD_OPT reference build;
+    # both then grind ~35 GTR+branch rounds on their frozen lattice).
+    # The continuous category-rate polish (psr.refine_category_rates,
+    # mod_opt rounds 4+) frees the representatives from the scan
+    # lattice and lands ~-14662, beating the reference by ~40 lnL —
+    # so the criterion is one-sided: never meaningfully worse.
+    ref = _fixture_lnl("ref49psr")
+    assert inst.likelihood >= ref - 1.0, (inst.likelihood, ref)
 
 
 def _ref_tree_eval(tmp, aln, model, tree) -> float:
@@ -117,6 +118,53 @@ def _ref_tree_eval(tmp, aln, model, tree) -> float:
     m = re.search(r"Likelihood tree 0: (-?\d+\.\d+)", info)
     assert m, info
     return float(m.group(1))
+
+
+@have_ref_binaries
+@pytest.mark.slow
+def test_full_search_endpoint_matches_reference(tmp_path):
+    """Live -f d parity: run the reference's computeBIGRAPID hill climb
+    (`searchAlgo.c:1914-2631`) and ours on testData/49 from the same
+    start tree, and compare endpoints — final lnL within 1 (one-sided:
+    ours may be better) and result topologies within a small relative
+    RF.  This is the single most load-bearing capability claim: the
+    full lazy/thorough SPR cycles, radius auto-tune, cutoff heuristic,
+    and interleaved model optimization all feed the endpoint."""
+    tmp = str(tmp_path)
+    subprocess.run([REF_PARSER, "-s", f"{TESTDATA}/49", "-q",
+                    f"{TESTDATA}/49.model", "-m", "DNA", "-n", "aln"],
+                   check=True, cwd=tmp, capture_output=True)
+    out = os.path.join(tmp, "out")
+    os.makedirs(out, exist_ok=True)
+    subprocess.run([REF_EXAML, "-s", "aln.binary", "-t",
+                    f"{TESTDATA}/49.tree", "-m", "GAMMA", "-n", "REFD",
+                    "-f", "d", "-w", out + "/"],
+                   check=True, cwd=tmp, capture_output=True, timeout=3600)
+    info = open(os.path.join(out, "ExaML_info.REFD")).read()
+    m = re.search(r"After SLOW SPRs Final (-?\d+\.\d+)", info)
+    assert m, info[-3000:]
+    ref_lnl = float(m.group(1))
+    ref_newick = open(os.path.join(out, "ExaML_result.REFD")).read()
+
+    from examl_tpu.search.raxml_search import (SearchOptions,
+                                               compute_big_rapid)
+    inst = PhyloInstance(load_alignment(f"{TESTDATA}/49",
+                                        f"{TESTDATA}/49.model"))
+    tree = inst.tree_from_newick(open(f"{TESTDATA}/49.tree").read())
+    inst.evaluate(tree, full=True)
+    res = compute_big_rapid(inst, tree, SearchOptions())
+    ours_lnl = float(res.likelihood)
+
+    # Both endpoints are local optima of the same heuristic; ours must
+    # not be meaningfully worse (better is fine).
+    assert ours_lnl >= ref_lnl - 1.0, (ours_lnl, ref_lnl)
+
+    from examl_tpu.search.convergence import relative_rf
+    from examl_tpu.search.snapshots import topology_key
+    ref_tree = inst.tree_from_newick(ref_newick)
+    rf = relative_rf(topology_key(tree), topology_key(ref_tree),
+                     inst.alignment.ntaxa)
+    assert rf <= 0.25, rf     # same neighborhood of tree space
 
 
 @have_ref_binaries

@@ -42,17 +42,27 @@ def tree49_text():
         return f.read()
 
 
-def _pair(data, text):
-    """(unsharded instance, 8-way sharded instance) on identical input."""
+@pytest.fixture(scope="module")
+def pair49(data49):
+    """(unsharded, 8-way sharded) instances, built ONCE for the module:
+    instances are tree-agnostic (the tree is a per-call argument and
+    every test starts with a fresh tree + full evaluate), so sharing
+    them drops the repeated engine construction/compile cost that
+    dominated this battery's wall time."""
     sh = default_site_sharding(8)
-    inst1 = PhyloInstance(data)
-    inst8 = PhyloInstance(data, block_multiple=8, sharding=sh)
+    inst1 = PhyloInstance(data49)
+    inst8 = PhyloInstance(data49, block_multiple=8, sharding=sh)
+    return inst1, inst8
+
+
+def _pair_trees(pair, text):
+    inst1, inst8 = pair
     return (inst1, inst1.tree_from_newick(text),
             inst8, inst8.tree_from_newick(text))
 
 
-def test_sharded_lnl_matches_unsharded(data49, tree49_text):
-    inst1, tree1, inst8, tree8 = _pair(data49, tree49_text)
+def test_sharded_lnl_matches_unsharded(pair49, tree49_text):
+    inst1, tree1, inst8, tree8 = _pair_trees(pair49, tree49_text)
     lnl1 = inst1.evaluate(tree1, full=True)
     lnl8 = inst8.evaluate(tree8, full=True)
     # Same math, different block padding/summation grouping: f64 agreement
@@ -63,8 +73,8 @@ def test_sharded_lnl_matches_unsharded(data49, tree49_text):
     assert len(eng.clv.sharding.device_set) == 8
 
 
-def test_sharded_derivatives_match(data49, tree49_text):
-    inst1, tree1, inst8, tree8 = _pair(data49, tree49_text)
+def test_sharded_derivatives_match(pair49, tree49_text):
+    inst1, tree1, inst8, tree8 = _pair_trees(pair49, tree49_text)
     inst1.evaluate(tree1, full=True)
     inst8.evaluate(tree8, full=True)
     for (inst, tree) in ((inst1, tree1), (inst8, tree8)):
@@ -83,8 +93,8 @@ def test_sharded_derivatives_match(data49, tree49_text):
     np.testing.assert_allclose(a2, b2, rtol=1e-9)
 
 
-def test_sharded_newton_branch_matches(data49, tree49_text):
-    inst1, tree1, inst8, tree8 = _pair(data49, tree49_text)
+def test_sharded_newton_branch_matches(pair49, tree49_text):
+    inst1, tree1, inst8, tree8 = _pair_trees(pair49, tree49_text)
     inst1.evaluate(tree1, full=True)
     inst8.evaluate(tree8, full=True)
     z1 = inst1.makenewz(tree1, tree1.nodep[5], tree1.nodep[5].back,
@@ -94,13 +104,13 @@ def test_sharded_newton_branch_matches(data49, tree49_text):
     np.testing.assert_allclose(z1, z8, rtol=1e-10)
 
 
-def test_sharded_spr_cycle(data49, tree49_text):
+def test_sharded_spr_cycle(pair49, tree49_text):
     """One lazy SPR rearrangement cycle must pick the same moves sharded."""
     from examl_tpu.search.raxml_search import tree_optimize_rapid
     from examl_tpu.search.snapshots import BestList, InfoList
     from examl_tpu.search.spr import SprContext
 
-    inst1, tree1, inst8, tree8 = _pair(data49, tree49_text)
+    inst1, tree1, inst8, tree8 = _pair_trees(pair49, tree49_text)
     out = []
     for inst, tree in ((inst1, tree1), (inst8, tree8)):
         inst.evaluate(tree, full=True)
