@@ -76,20 +76,26 @@ FALLBACK_AVX_UPDATES_PER_SEC = 2.0e9
 # large configs therefore always measure the SCAN variant (the
 # best-variant hint only helps resumed workers); if a faster tier
 # proves itself on hardware, promote it by reordering here.
-TPU_PLAN = ["s-scan", "L:dna-large", "L:aa-large", "pallas-check",
-            "s-chunks", "s-pallas", "s-whole", "prims"]
+TPU_PLAN = ["s-scan", "L:dna-large", "L:aa-large", "L:dna-psr",
+            "L:dna-sev", "pallas-check", "s-chunks", "s-pallas",
+            "s-whole", "prims"]
 # The CPU fallback also records one (small) large-config row so every
 # BENCH artifact carries compute-bound evidence tagged with its backend.
 CPU_PLAN = ["s-scan", "L:dna-mid", "s-chunks", "prims"]
 
 LARGE_CONFIGS = {
-    # name: (ntaxa, patterns, datatype) — sized to keep the f32 CLV
-    # arena under ~8 GB HBM while holding >1e8 site-updates in flight.
-    "dna-large": (140, 524_288, "DNA"),
-    "aa-large": (140, 131_072, "AA"),
-    "dna-1000": (1_000, 131_072, "DNA"),
+    # name: (ntaxa, patterns, datatype, mode) — sized to keep the f32
+    # CLV arena under ~8 GB HBM while holding >1e8 site-updates in
+    # flight.  mode: "" plain GAMMA; "psr" per-site-rate multipliers
+    # ride every P application (BASELINE config 4); "sev" gappy
+    # clade-structured alignment traversed on the -S pool (config 5).
+    "dna-large": (140, 524_288, "DNA", ""),
+    "aa-large": (140, 131_072, "AA", ""),
+    "dna-1000": (1_000, 131_072, "DNA", ""),
+    "dna-psr": (140, 262_144, "DNA", "psr"),
+    "dna-sev": (140, 262_144, "DNA", "sev"),
     # CPU-fallback-sized: compute-bound on a host core, ~1.2 GB f64.
-    "dna-mid": (140, 32_768, "DNA"),
+    "dna-mid": (140, 32_768, "DNA", ""),
 }
 
 
@@ -120,13 +126,18 @@ def _load_instance():
 
 
 def _synthetic_instance(ntaxa: int, width: int, datatype: str = "DNA",
-                        dtype=None):
+                        dtype=None, mode: str = ""):
     """A synthetic compute-bound benchmark alignment, built WITHOUT
     pattern compression (random sites do not compress; weights are 1):
     big enough that the traversal is HBM/MXU-bound rather than
     dispatch-bound — the regime the small testData sets cannot reach
     (SURVEY §6 recommends 3-4k DNA / ~1k AA patterns PER CORE on the
-    reference; one chip replaces a whole socket)."""
+    reference; one chip replaces a whole socket).
+
+    mode "psr": PSR rate model with a randomized 25-category
+    categorization installed (the per-site-rate multiplier path).
+    mode "sev": clade-structured gaps (half the taxa per alignment
+    half) traversed on the -S pool."""
     from examl_tpu import datatypes
     from examl_tpu.instance import PhyloInstance
     from examl_tpu.io.alignment import AlignmentData, PartitionData
@@ -136,21 +147,53 @@ def _synthetic_instance(ntaxa: int, width: int, datatype: str = "DNA",
     if datatype == "DNA":
         codes = rng.choice(np.array([1, 2, 4, 8], dtype=np.uint8),
                            size=(ntaxa, width))
+    else:
+        codes = rng.integers(0, 20, size=(ntaxa, width), dtype=np.uint8)
+    if mode == "sev":
+        # Clade-structured gaps: taxon half x alignment half (the -S
+        # regime).  Subtree-all-gap then triggers on real block runs,
+        # as in SEVRATIO.md's clade fixture.
+        codes[: ntaxa // 2, : width // 2] = dt.undetermined_code
+        codes[ntaxa // 2:, width // 2:] = dt.undetermined_code
+    if datatype == "DNA":
         part = PartitionData(
             name="bench", datatype=dt, model_name="DNA",
             patterns=codes, weights=np.ones(width, dtype=np.int64),
             empirical_freqs=np.full(4, 0.25), use_empirical_freqs=True,
             optimize_freqs=False)
     else:
-        codes = rng.integers(0, 20, size=(ntaxa, width), dtype=np.uint8)
         part = PartitionData(
             name="bench", datatype=dt, model_name="LG",
             patterns=codes, weights=np.ones(width, dtype=np.int64),
             empirical_freqs=np.full(20, 0.05), use_empirical_freqs=False,
             optimize_freqs=False)
     inst = PhyloInstance(AlignmentData([f"t{i}" for i in range(ntaxa)],
-                                       [part]), dtype=dtype)
-    return inst, inst.random_tree(0)
+                                       [part]),
+                         dtype=dtype,
+                         rate_model="PSR" if mode == "psr" else "GAMMA",
+                         save_memory=(mode == "sev"))
+    if mode == "psr":
+        # Install a realistic 25-category lattice so the factorized
+        # per-site P path (not a degenerate all-1.0 grid) is timed.
+        for gid in range(inst.num_parts):
+            cats = np.sort(rng.gamma(2.0, 0.5, 25))
+            cat_of = rng.integers(0, 25, inst.patrat[gid].shape[0])
+            rates = cats[cat_of]
+            mean = float(rates.mean())
+            inst.per_site_rates[gid] = cats / mean
+            inst.rate_category[gid] = cat_of.astype(np.int32)
+        inst.push_site_rates()
+    if mode == "sev":
+        # Caterpillar in taxon order: the taxon-half gap structure then
+        # IS a clade split, the -S regime (SEVRATIO.md).  A random tree
+        # scatters the halves and the pool saves almost nothing.
+        part = "(t0:0.1,t1:0.1)"
+        for i in range(2, ntaxa):
+            part = f"({part}:0.1,t{i}:0.1)"
+        tree = inst.tree_from_newick(part + ";")
+    else:
+        tree = inst.random_tree(0)
+    return inst, tree
 
 
 # ---------------------------------------------------------------------------
@@ -206,6 +249,17 @@ def _variant_step(eng, variant, entries):
     from examl_tpu.ops import kernels
 
     if variant == "scan":
+        if eng.save_memory:
+            eng._sev_begin(entries)       # gap/cell bookkeeping + sync
+            aux = (eng.sev.slot_read, eng.sev.slot_write)
+            tv = eng._traversal_arrays(entries)
+
+            def step(c, s):
+                return kernels.traverse_pooled(
+                    eng.models, eng.block_part, eng.tips, c, aux[0],
+                    aux[1], s, tv, eng.scale_exp, eng.ntips,
+                    eng.site_rates)
+            return step
         tv = eng._traversal_arrays(entries)
 
         def step(c, s):
@@ -267,7 +321,8 @@ def _measure_variant(inst, tree, eng, entries, variant) -> dict:
     tier = (eng.use_pallas, eng.pallas_whole)
     try:
         fn = _chained(_variant_step(eng, variant, entries), n_steps)
-        dt, compile_s, flops = _time_compiled(fn, eng.clv, eng.scaler)
+        buf = eng._state()[0] if eng.save_memory else eng.clv
+        dt, compile_s, flops = _time_compiled(fn, buf, eng.scaler)
     finally:
         eng.use_pallas, eng.pallas_whole = tier
     updates = n_steps * len(entries) * patterns * eng.R * eng.K
@@ -334,13 +389,27 @@ def _stage_small(state: _WorkerState, variant: str) -> dict:
 
 
 def _stage_large(cfg: str, variant: str) -> dict:
-    ntaxa, width, dtname = LARGE_CONFIGS[cfg]
-    inst, tree = _synthetic_instance(ntaxa, width, dtname)
+    ntaxa, width, dtname, mode = LARGE_CONFIGS[cfg]
+    inst, tree = _synthetic_instance(ntaxa, width, dtname, mode=mode)
     (eng,) = inst.engines.values()
+    if mode:
+        # PSR rides the scan tier (the fast/Pallas tiers are
+        # GAMMA-only); the SEV pool likewise traverses via the pooled
+        # scan kernel.  Record the mode's own tier honestly instead of
+        # inheriting the GAMMA winner hint.
+        variant = "scan"
     _, entries = tree.full_traversal_centroid()
     try:
         out = _measure_variant(inst, tree, eng, entries, variant)
         out["config"] = cfg
+        if mode:
+            out["mode"] = mode
+        if mode == "sev":
+            # ups counts LOGICAL site updates; the pool computes only
+            # stored (non-all-gap) cells, so this row measures -S's
+            # effective throughput on gappy data, not raw kernel speed.
+            out["sev_stats"] = {k: v for k, v in eng.sev.stats().items()
+                                if k != "cell_bytes"}
         return out
     finally:
         del inst, tree, eng    # free the multi-GB arena before the next

@@ -158,7 +158,7 @@ def test_refine_category_rates_improves_and_stays_normalized():
 
 
 @pytest.mark.slow
-def test_refine_category_rates_per_partition_branches():
+def test_refine_category_rates_per_partition_branches(tmp_path):
     """Under -M the refinement must keep EACH partition's weighted mean
     rate at 1 (the reference's updatePerSiteRates numBranches>1 arm),
     compensating each partition's branch slot with its own exponent."""
@@ -166,7 +166,6 @@ def test_refine_category_rates_per_partition_branches():
     from examl_tpu.optimize.branch import tree_evaluate
     from examl_tpu.optimize.psr import (optimize_rate_categories,
                                         refine_category_rates)
-    import tempfile, os
 
     rng = np.random.default_rng(17)
     n, gene = 10, 240
@@ -177,7 +176,7 @@ def test_refine_category_rates_per_partition_branches():
         flip = rng.random(2 * gene) < 0.2
         cur = np.where(flip, rng.integers(0, 4, 2 * gene), cur)
         seqs.append("".join("ACGT"[c] for c in cur))
-    mp = os.path.join(tempfile.mkdtemp(), "p.model")
+    mp = str(tmp_path / "p.model")
     with open(mp, "w") as f:
         f.write(f"DNA, g1 = 1-{gene}\nDNA, g2 = {gene+1}-{2*gene}\n")
     from examl_tpu.io.alignment import build_alignment_data

@@ -242,8 +242,9 @@ def _matrix_setup_inner(large: bool):
         _sys.path.insert(0, os.path.dirname(os.path.dirname(
             os.path.abspath(__file__))))
         from bench import LARGE_CONFIGS, _synthetic_instance
-        inst, tree = _synthetic_instance(*LARGE_CONFIGS["dna-large"],
-                                         dtype=jnp.float32)
+        ntaxa, width, dtname, mode = LARGE_CONFIGS["dna-large"]
+        inst, tree = _synthetic_instance(ntaxa, width, dtname,
+                                         dtype=jnp.float32, mode=mode)
         eng = next(iter(inst.engines.values()))
     else:
         inst = default_instance(f"{DATA}/140", f"{DATA}/140.model",

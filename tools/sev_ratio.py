@@ -132,8 +132,7 @@ def _cells(data, seed=11, newick=None):
     gap = {}
     for t in range(1, data.ntaxa + 1):
         codes = np.full(W, undet, np.uint8)
-        off = 0
-        for li, gid in enumerate(bucket.part_ids):
+        for li in range(len(bucket.part_ids)):
             idx = bucket.site_indices(li)
             codes[idx] = bucket.tip_codes[t - 1][idx]
         gap[t] = codes == undet
@@ -164,7 +163,7 @@ def _cells(data, seed=11, newick=None):
         "dense": dense,
         "ref_per_site": ref_start,       # the reference's real behavior
         "block_start": block_start,      # granularity-only comparison
-        "ref_centroid": ref_cent,
+        "ref_centroid": ref_cent,        # per-site @ centroid
         "ideal_block": block_cent,       # = this repo's granularity
         "pool_actual": st["allocated_cells"],
         "pool_rows": st["dense_cells"] // max(B, 1),
@@ -178,6 +177,7 @@ def _fmt_row(name, c):
     return (f"| {name} | {c['inners']}x{c['B']} = {d} | "
             f"{c['ref_per_site']:.0f} ({1 - c['ref_per_site'] / d:.1%}) | "
             f"{c['block_start']} ({1 - c['block_start'] / d:.1%}) | "
+            f"{c['ref_centroid']:.0f} ({1 - c['ref_centroid'] / d:.1%}) | "
             f"{c['ideal_block']} ({1 - c['ideal_block'] / d:.1%}) | "
             f"{c['pool_actual']} ({1 - c['pool_actual'] / (c['pool_rows'] * c['B']):.1%}) |")
 
@@ -222,6 +222,9 @@ def _live_reference(names, seqs, spec, workdir, newick=None):
              "-s", "aln.binary", "-t", tf, "-m", "GAMMA", "-n", tag,
              "-f", "e", "-w", out + "/"] + extra,
             cwd=workdir, capture_output=True, text=True, timeout=3600)
+        if p.returncode != 0:
+            sys.stderr.write(f"reference run ({tag}) failed rc="
+                             f"{p.returncode}:\n{p.stderr[-2000:]}\n")
         m = re.search(r"MAXRSS_KB (\d+)", p.stdout)
         rss[tag] = int(m.group(1)) if m else None
     return rss
@@ -252,9 +255,9 @@ def main():
         "count).",
         "",
         "| alignment | dense cells | reference (per-site, its tip "
-        "rooting) | block @ tip rooting | block @ centroid rooting | "
-        "pool actual |",
-        "|---|---|---|---|---|---|",
+        "rooting) | block @ tip rooting | per-site @ centroid | "
+        "block @ centroid rooting | pool actual |",
+        "|---|---|---|---|---|---|---|",
     ]
 
     def _load(names, seqs, spec):
@@ -293,10 +296,16 @@ def main():
             "Live reference `examl-AVX -f e` peak RSS on the "
             "clade-structured alignment (caterpillar tree):",
             "",
-            f"- without `-S`: {rss['dense']} kB",
-            f"- with `-S`:    {rss['sev']} kB "
-            f"({1 - rss['sev'] / rss['dense']:.1%} saved)"
-            if rss["dense"] and rss["sev"] else "- (RSS capture failed)",
+        ]
+        if rss["dense"] and rss["sev"]:
+            lines += [
+                f"- without `-S`: {rss['dense']} kB",
+                f"- with `-S`:    {rss['sev']} kB "
+                f"({1 - rss['sev'] / rss['dense']:.1%} saved)",
+            ]
+        else:
+            lines += ["- (RSS capture failed — see stderr)"]
+        lines += [
             "",
             "RSS includes the binary's non-CLV state (tip sequences, "
             "P-matrix buffers, parser tables), so the percentage "
