@@ -408,8 +408,14 @@ def _stage_large(cfg: str, variant: str) -> dict:
             # ups counts LOGICAL site updates; the pool computes only
             # stored (non-all-gap) cells, so this row measures -S's
             # effective throughput on gappy data, not raw kernel speed.
-            out["sev_stats"] = {k: v for k, v in eng.sev.stats().items()
+            st = eng.sev.stats()
+            out["sev_stats"] = {k: v for k, v in st.items()
                                 if k != "cell_bytes"}
+            if "gbps" in out and st["dense_cells"]:
+                # The dense-row traffic model overstates pooled
+                # traversals; scale by the stored-cell fraction.
+                out["gbps"] = round(out["gbps"] * st["allocated_cells"]
+                                    / st["dense_cells"], 2)
         return out
     finally:
         del inst, tree, eng    # free the multi-GB arena before the next
@@ -792,6 +798,10 @@ def _assemble(results: dict, notes: list, cpu_fallback: bool) -> str:
                 f"{pre}_tflops_per_sec": r.get("tflops_per_sec"),
                 f"{pre}_mfu": r.get("mfu"),
                 f"{pre}_achieved_gbps": r.get("gbps")})
+            if "mode" in r:
+                doc[f"{pre}_mode"] = r["mode"]
+            if "sev_stats" in r:
+                doc[f"{pre}_sev_stats"] = r["sev_stats"]
         else:
             doc[f"{pre}_error"] = r.get("error", "?")
     # Pallas first-contact validation record (None = stage not run,

@@ -141,7 +141,7 @@ def test_full_search_endpoint_matches_reference(tmp_path):
                     "-f", "d", "-w", out + "/"],
                    check=True, cwd=tmp, capture_output=True, timeout=3600)
     info = open(os.path.join(out, "ExaML_info.REFD")).read()
-    m = re.search(r"After SLOW SPRs Final (-?\d+\.\d+)", info)
+    m = re.search(r"Likelihood of best tree: (-?\d+\.\d+)", info)
     assert m, info[-3000:]
     ref_lnl = float(m.group(1))
     ref_newick = open(os.path.join(out, "ExaML_result.REFD")).read()

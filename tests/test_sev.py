@@ -39,17 +39,23 @@ def _gappy_alignment(ntaxa=24, genes=3, gene_sites=384, seed=0):
     return names, seqs, "\n".join(parts)
 
 
-@pytest.fixture(scope="module")
-def gappy():
-    names, seqs, model_text = _gappy_alignment()
-    import tempfile, os
-    d = tempfile.mkdtemp()
-    mp = os.path.join(d, "parts.model")
+def _gappy_data(**kw):
+    """AlignmentData for a _gappy_alignment(**kw) (shared fixture
+    plumbing: model file written to a temp dir and parsed)."""
+    import os
+    import tempfile
+    names, seqs, model_text = _gappy_alignment(**kw)
+    mp = os.path.join(tempfile.mkdtemp(), "parts.model")
     with open(mp, "w") as f:
         f.write(model_text + "\n")
     from examl_tpu.io.partitions import parse_partition_file
     return build_alignment_data(names, seqs,
                                 specs=parse_partition_file(mp))
+
+
+@pytest.fixture(scope="module")
+def gappy():
+    return _gappy_data()
 
 
 def test_sev_lnl_matches_dense(gappy):
@@ -202,15 +208,25 @@ def test_sev_batched_scan_matches_dense(gappy):
                                rtol=1e-6, atol=5e-4)
 
 
+@pytest.fixture(scope="module")
+def gappy_small():
+    """Smaller fixture for the END-TO-END search smokes: a full
+    compute_big_rapid on the 24-taxon module fixture costs ~10 min of
+    1-CPU wall each; 14 taxa x 2 genes exercises the same code paths
+    (pool reallocation across SPR cycles, scan region growth) in a
+    fraction of it."""
+    return _gappy_data(ntaxa=14, genes=2, gene_sites=256)
+
+
 @pytest.mark.slow
-def test_sev_batched_search_improves(gappy, monkeypatch):
+def test_sev_batched_search_improves(gappy_small, monkeypatch):
     """-S search with the batched lazy arm FORCED on (the accelerator
     default keeps it sequential on CPU) improves lnL end-to-end."""
     from examl_tpu.search.raxml_search import SearchOptions, compute_big_rapid
     from examl_tpu.search.spr import batched_scan_enabled
 
     monkeypatch.setenv("EXAML_BATCH_SCAN", "1")
-    sev = PhyloInstance(gappy, save_memory=True)
+    sev = PhyloInstance(gappy_small, save_memory=True)
     assert batched_scan_enabled(sev)
     tree = sev.random_tree(5)
     start = sev.evaluate(tree, full=True)
@@ -222,10 +238,10 @@ def test_sev_batched_search_improves(gappy, monkeypatch):
 
 
 @pytest.mark.slow
-def test_sev_search_smoke(gappy):
+def test_sev_search_smoke(gappy_small):
     """A short -f d style search runs under SEV and improves lnL."""
     from examl_tpu.search.raxml_search import SearchOptions, compute_big_rapid
-    sev = PhyloInstance(gappy, save_memory=True)
+    sev = PhyloInstance(gappy_small, save_memory=True)
     tree = sev.random_tree(5)
     start = sev.evaluate(tree, full=True)
     res = compute_big_rapid(sev, tree,

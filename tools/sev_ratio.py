@@ -248,11 +248,13 @@ def main():
         "`reference (per-site, its tip rooting)` is the exact per-site "
         "compaction cell count (site granularity, shown in 128-lane "
         "block units) with the reference's tr->start rooting — its "
-        "real behavior; the two `block @` columns isolate granularity "
-        "vs rooting; `pool actual` is SevState.stats() after a real "
-        "traversal of this repo's engine (centroid rooting; pow2 "
-        "growth slack included, denominator uses the pool's own row "
-        "count).",
+        "real behavior.  The middle columns isolate the two design "
+        "axes: `block @ tip rooting` changes only granularity, "
+        "`per-site @ centroid` changes only rooting, and `block @ "
+        "centroid` combines both (= this repo's design).  `pool "
+        "actual` is SevState.stats() after a real traversal of this "
+        "repo's engine (pow2 growth slack included, denominator uses "
+        "the pool's own row count).",
         "",
         "| alignment | dense cells | reference (per-site, its tip "
         "rooting) | block @ tip rooting | per-site @ centroid | "
