@@ -76,9 +76,9 @@ FALLBACK_AVX_UPDATES_PER_SEC = 2.0e9
 # large configs therefore always measure the SCAN variant (the
 # best-variant hint only helps resumed workers); if a faster tier
 # proves itself on hardware, promote it by reordering here.
-TPU_PLAN = ["s-scan", "L:dna-large", "L:aa-large", "L:dna-psr",
-            "L:dna-sev", "pallas-check", "s-chunks", "s-pallas",
-            "s-whole", "prims"]
+TPU_PLAN = ["s-scan", "L:dna-large", "L:aa-large", "L:dna-bf16",
+            "L:dna-psr", "L:dna-sev", "pallas-check", "s-chunks",
+            "s-pallas", "s-whole", "prims"]
 # The CPU fallback also records one (small) large-config row so every
 # BENCH artifact carries compute-bound evidence tagged with its backend.
 CPU_PLAN = ["s-scan", "L:dna-mid", "s-chunks", "prims"]
@@ -94,6 +94,9 @@ LARGE_CONFIGS = {
     "dna-1000": (1_000, 131_072, "DNA", ""),
     "dna-psr": (140, 262_144, "DNA", "psr"),
     "dna-sev": (140, 262_144, "DNA", "sev"),
+    # bf16 CLV storage (ROOFLINE lever 3): same shape as dna-large,
+    # half the bytes/update — the throughput-ceiling doubler.
+    "dna-bf16": (140, 524_288, "DNA", "bf16"),
     # CPU-fallback-sized: compute-bound on a host core, ~1.2 GB f64.
     "dna-mid": (140, 32_768, "DNA", ""),
 }
@@ -137,7 +140,9 @@ def _synthetic_instance(ntaxa: int, width: int, datatype: str = "DNA",
     mode "psr": PSR rate model with a randomized 25-category
     categorization installed (the per-site-rate multiplier path).
     mode "sev": clade-structured gaps (half the taxa per alignment
-    half) traversed on the -S pool."""
+    half) traversed on the -S pool.
+    mode "bf16": bf16 CLV storage tier (f32 compute; EXAML_CLV_DTYPE
+    set for the engine build and restored after)."""
     from examl_tpu import datatypes
     from examl_tpu.instance import PhyloInstance
     from examl_tpu.io.alignment import AlignmentData, PartitionData
@@ -167,11 +172,23 @@ def _synthetic_instance(ntaxa: int, width: int, datatype: str = "DNA",
             patterns=codes, weights=np.ones(width, dtype=np.int64),
             empirical_freqs=np.full(20, 0.05), use_empirical_freqs=False,
             optimize_freqs=False)
-    inst = PhyloInstance(AlignmentData([f"t{i}" for i in range(ntaxa)],
-                                       [part]),
-                         dtype=dtype,
-                         rate_model="PSR" if mode == "psr" else "GAMMA",
-                         save_memory=(mode == "sev"))
+    prior_clv_env = os.environ.get("EXAML_CLV_DTYPE")
+    if mode == "bf16":
+        import jax.numpy as jnp
+        dtype = jnp.float32          # the tier requires f32 compute
+        os.environ["EXAML_CLV_DTYPE"] = "bf16"
+    try:
+        inst = PhyloInstance(
+            AlignmentData([f"t{i}" for i in range(ntaxa)], [part]),
+            dtype=dtype,
+            rate_model="PSR" if mode == "psr" else "GAMMA",
+            save_memory=(mode == "sev"))
+    finally:
+        if mode == "bf16":
+            if prior_clv_env is None:
+                os.environ.pop("EXAML_CLV_DTYPE", None)
+            else:
+                os.environ["EXAML_CLV_DTYPE"] = prior_clv_env
     if mode == "psr":
         # Install a realistic 25-category lattice so the factorized
         # per-site P path (not a degenerate all-1.0 grid) is timed.
@@ -392,12 +409,17 @@ def _stage_large(cfg: str, variant: str) -> dict:
     ntaxa, width, dtname, mode = LARGE_CONFIGS[cfg]
     inst, tree = _synthetic_instance(ntaxa, width, dtname, mode=mode)
     (eng,) = inst.engines.values()
-    if mode:
+    if mode in ("psr", "sev"):
         # PSR rides the scan tier (the fast/Pallas tiers are
         # GAMMA-only); the SEV pool likewise traverses via the pooled
         # scan kernel.  Record the mode's own tier honestly instead of
         # inheriting the GAMMA winner hint.
         variant = "scan"
+    elif mode == "bf16" and variant in ("pallas", "whole"):
+        # The engine refuses Pallas dispatch when storage_dtype !=
+        # compute dtype (engine gate); don't bench a combination no
+        # production run can use.
+        variant = "chunks"
     _, entries = tree.full_traversal_centroid()
     try:
         out = _measure_variant(inst, tree, eng, entries, variant)

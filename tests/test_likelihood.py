@@ -31,6 +31,29 @@ def test_lnl_matches_oracle_partitioned(data49, tree49_text):
     assert abs(lnl - ref) / abs(ref) < 1e-10, (lnl, ref)
 
 
+def test_lnl_matches_oracle_binary():
+    """2-state (BIN) data end-to-end against the independent scipy-expm
+    oracle — the morphological-data path (reference `BINARY_DATA`
+    kernels, `newviewGenericSpecial.c:5871-6218`)."""
+    from examl_tpu.io.alignment import build_alignment_data
+
+    rng = np.random.default_rng(9)
+    names = [f"t{i}" for i in range(12)]
+    cur = rng.integers(0, 2, 300)
+    seqs = []
+    for _ in names:
+        flip = rng.random(300) < 0.2
+        cur = np.where(flip, rng.integers(0, 2, 300), cur)
+        seqs.append("".join("01"[c] for c in cur))
+    data = build_alignment_data(names, seqs, datatype_name="BIN")
+    inst = PhyloInstance(data)
+    tree = inst.random_tree(seed=4)
+    lnl = inst.evaluate(tree, full=True)
+    ref = oracle_lnl(tree, data, inst.models)
+    assert lnl < 0
+    assert abs(lnl - ref) / abs(ref) < 1e-9, (lnl, ref)
+
+
 def test_lnl_alpha_and_rates(data49, tree49_text):
     from examl_tpu.models.gtr import with_alpha, with_rates
     inst = PhyloInstance(data49)
