@@ -91,9 +91,6 @@ class LikelihoodEngine:
         self.ntips = ntips
         self.psr = psr
         self.save_memory = save_memory
-        if save_memory and psr:
-            raise ValueError("-S (SEV) is not supported under PSR "
-                             "(the reference likewise restricts -S)")
         self.dtype = jnp.dtype(dtype)
         self.scale_exp = (scale_exp if scale_exp is not None
                           else kernels.default_scale_exponent(self.dtype))
@@ -347,6 +344,10 @@ class LikelihoodEngine:
             "aux": (P(None, AX), P(None, AX)),    # slot_read, slot_write
             "blocks": P(AX),                      # block_part [B]
             "sites": P(AX),                       # weights [B, lane]
+            # site_rates [B, lane, 1] shards its block axis under PSR;
+            # GAMMA passes the literal None leaf, whose spec must be
+            # None for the pytrees to match.
+            "sr": P(AX) if self.psr else None,
             "tips": kernels.TipState(codes=P(None, AX), table=REP),
             "models": DeviceModels(*(REP,) * len(DeviceModels._fields)),
             "traversal": Traversal(*(REP,) * len(Traversal._fields)),
@@ -367,29 +368,29 @@ class LikelihoodEngine:
         `evaluateGenericSpecial.c:968-973`,
         `makenewzGenericSpecial.c:1241-1248`)."""
         v = self._sev_spec_vocab()
-        (REP, pool_s, sc_s, aux_s, b_s, bl_s, tips_s, dm_s, tv_s,
+        (REP, pool_s, sc_s, aux_s, b_s, bl_s, tips_s, dm_s, tv_s, sr_s,
          wrap) = (v["rep"], v["pool"], v["scaler"], v["aux"], v["blocks"],
                   v["sites"], v["tips"], v["models"], v["traversal"],
-                  v["wrap"])
+                  v["sr"], v["wrap"])
 
         self._jit_traverse = wrap(
             self._traverse_only_impl,
-            (pool_s, sc_s, aux_s, tv_s, dm_s, b_s, tips_s, None),
+            (pool_s, sc_s, aux_s, tv_s, dm_s, b_s, tips_s, sr_s),
             (pool_s, sc_s), donate=(0, 1))
         self._jit_evaluate = wrap(
             self._evaluate_impl,
             (pool_s, sc_s, aux_s, REP, REP, REP, dm_s, b_s, bl_s,
-             tips_s, None),
+             tips_s, sr_s),
             REP)
         self._jit_trav_eval = wrap(
             self._trav_eval_impl,
             (pool_s, sc_s, aux_s, tv_s, REP, REP, REP, dm_s, b_s, bl_s,
-             tips_s, None),
+             tips_s, sr_s),
             (pool_s, sc_s, REP), donate=(0, 1))
         self._jit_newton = wrap(
             self._newton_impl,
             (pool_s, sc_s, aux_s, tv_s, REP, REP, REP, REP, REP, dm_s,
-             b_s, bl_s, tips_s, None),
+             b_s, bl_s, tips_s, sr_s),
             (pool_s, sc_s, REP), donate=(0, 1))
         st_s = b_s                          # sumtable [B, lane, R, K]
         self._jit_sumtable = wrap(
@@ -398,7 +399,7 @@ class LikelihoodEngine:
             st_s)
         self._jit_derivs = wrap(
             self._derivs_impl,
-            (st_s, REP, dm_s, b_s, bl_s, None),
+            (st_s, REP, dm_s, b_s, bl_s, sr_s),
             (REP, REP))
 
     # -- construction helpers ---------------------------------------------
@@ -972,7 +973,7 @@ class LikelihoodEngine:
             jnp.asarray(upg.reshape(n_chunks, T)),
             jnp.asarray(zq0.reshape(n_chunks, T), dtype=self.dtype),
             jnp.int32(self._gidx(plan.s_num)), self.models,
-            self.block_part, self.weights, self.tips)
+            self.block_part, self.weights, self.tips, self.site_rates)
         self._set_buf(buf)
         N = len(plan.candidates)
         return np.asarray(lnls)[:N], np.asarray(es)[:N]

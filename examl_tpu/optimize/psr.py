@@ -60,6 +60,12 @@ def _scan_partition_rates(inst: PhyloInstance, tree: Tree,
     p, entries = tree.full_traversal()
     up = upper * np.arange(1, RATE_STEPS + 1)
     down = -lower * np.arange(1, RATE_STEPS + 1)
+    if inst.save_memory:
+        # The rate scan's scratch CLV is DENSE [rows, B, lane, G, K]
+        # inside its program (engine._rate_scan_impl) — G x a
+        # single-rate dense arena.  -S runs exist because dense does
+        # not fit; keep the transient peak at ~2 dense arenas.
+        grid_chunk = min(grid_chunk, 2)
 
     for states, bucket in inst.buckets.items():
         eng = inst.engines[states]

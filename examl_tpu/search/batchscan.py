@@ -300,7 +300,7 @@ def scan_program(eng, n_chunks: int):
             impl,
             (v["pool"], v["scaler"], v["aux"], v["traversal"], REP, REP,
              REP, REP, REP, v["models"], v["blocks"], v["sites"],
-             v["tips"], None),
+             v["tips"], v["sr"]),
             (v["pool"], v["scaler"], REP), donate=(0, 1))
     else:
         fn = jax.jit(impl, donate_argnums=(0, 1))
@@ -354,12 +354,13 @@ def thorough_program(eng, n_chunks: int):
 
     scale_exp = eng.scale_exp
     ntips = eng.ntips
+    psr = eng.psr
     lzmax = float(np.log(ZMAX))
 
     def impl(clv, scaler, aux, tv, qg, upg, zq0, sg, dm, block_part,
-             weights, tips):
+             weights, tips, sr_rates):
         clv, scaler = eng._traverse_kernel(clv, aux, scaler, tv, dm,
-                                           block_part, tips, None)
+                                           block_part, tips, sr_rates)
         xs, ss = eng._gather(clv, aux, scaler, sg, tips)
         cdt = tips.table.dtype        # compute dtype (arena may store bf16)
         minlik, two_e, _ = kernels.scale_constants(cdt, scale_exp)
@@ -367,6 +368,9 @@ def thorough_program(eng, n_chunks: int):
         _, _, log_min = kernels.scale_constants(acc, scale_exp)
 
         def papply(z, x):
+            if psr:
+                d = kernels.psr_decay(dm, block_part, sr_rates, z[None])
+                return kernels.apply_p_factorized(dm, block_part, d, x)
             return kernels.apply_p(kernels.p_matrices(dm, z[None]),
                                    block_part, x)
 
@@ -376,7 +380,7 @@ def thorough_program(eng, n_chunks: int):
                 dm, block_part, weights, st,
                 jnp.full(1, z0, dtype=cdt),
                 jnp.full(1, iters, jnp.int32), jnp.zeros(1, bool), 1,
-                axis_name=eng._axis_name)[0]
+                site_rates=sr_rates, axis_name=eng._axis_name)[0]
 
         def one(xq1, sq1, xr1, sr1, z01):
             zqr = nr(xq1, xr1, z01, SPR_NR_ITERATIONS)
@@ -431,7 +435,8 @@ def thorough_program(eng, n_chunks: int):
             xp = jnp.where(needs[:, :, None, None], xp * two_e, xp)
             scp = sq1 + ss + needs.astype(jnp.int32)
             lsite = kernels.site_likelihoods(dm, block_part, xp, xr1,
-                                             e2[None])
+                                             e2[None],
+                                             site_rates=sr_rates)
             lsite = jnp.maximum(lsite, jnp.finfo(lsite.dtype).tiny)
             sc = (scp + sr1).astype(acc)
             lnl = jnp.sum(weights.astype(acc)
@@ -461,7 +466,8 @@ def thorough_program(eng, n_chunks: int):
         fn = v["wrap"](
             impl,
             (v["pool"], v["scaler"], v["aux"], v["traversal"], REP, REP,
-             REP, REP, v["models"], v["blocks"], v["sites"], v["tips"]),
+             REP, REP, v["models"], v["blocks"], v["sites"], v["tips"],
+             v["sr"]),
             (v["pool"], v["scaler"], REP, REP), donate=(0, 1))
     else:
         fn = jax.jit(impl, donate_argnums=(0, 1))

@@ -513,26 +513,25 @@ def thorough_batched_ok(inst: PhyloInstance) -> bool:
     slot: the triangle/smoothing Newton loops iterate on device, so
     mixed buckets (whose derivatives must sum across engines per
     iteration) and per-partition branch masks keep the sequential
-    primitives; PSR keeps the sequential thorough arm too (the batched
-    triangle/smoothing uses the GAMMA P-matrix form).  -S SEV pools are
-    supported like the lazy arm (the program goes through the engine's
-    state-agnostic primitives and shard_maps under SEV x sharding).
+    primitives.  GAMMA and PSR both batch (PSR via the factorized
+    per-site P form, like the lazy arm); -S SEV pools are supported
+    like the lazy arm (state-agnostic primitives, shard_map under
+    SEV x sharding, PSR site-rates sharded along the block axis).
 
     It is also gated to ACCELERATOR devices: it trades compute (the
     whole window, no cutoff early-outs) for dispatches, which wins where
     dispatch latency dominates (the TPU tunnel) and loses on host CPU,
     where the sequential cutoff arm is cheaper.  EXAML_BATCH_SCAN=0 or
     EXAML_BATCH_THOROUGH=0 force it off anywhere; =1 forces it on WHERE
-    THE STRUCTURAL REQUIREMENTS HOLD (one bucket, one slot, no PSR) --
-    those are hard constraints of the on-device Newton loops, not
+    THE STRUCTURAL REQUIREMENTS HOLD (one bucket, one slot) -- those
+    are hard constraints of the on-device Newton loops, not
     preferences.
     """
     import os
     forced = os.environ.get("EXAML_BATCH_THOROUGH")
     if forced == "0" or os.environ.get("EXAML_BATCH_SCAN") == "0":
         return False
-    if not (len(inst.engines) == 1 and inst.num_branch_slots == 1
-            and not getattr(inst, "psr", False)):
+    if not (len(inst.engines) == 1 and inst.num_branch_slots == 1):
         return False
     if forced == "1":
         return True
@@ -552,7 +551,7 @@ def rearrange_auto(inst: PhyloInstance, tree: Tree, ctx: SprContext,
     """Dispatch-latency-aware rearrange: one device program per pruned
     node for both arms.  The lazy scan batches for GAMMA and PSR alike;
     the thorough arm batches on accelerator devices for single-bucket,
-    single-slot GAMMA instances (thorough_batched_ok), dense or -S.
+    single-slot instances, GAMMA or PSR (thorough_batched_ok), dense or -S.
     Sequential primitives remain for mixed state buckets and
     per-partition branches (the on-device Newton loops cannot sum
     derivatives across engines), and wherever the env switches force

@@ -124,6 +124,25 @@ def test_down_entries_dependency_ordered():
     assert checked >= 3
 
 
+def _sequential_thorough(inst, tree, ctx, p, plan):
+    """Sequential thorough scores + smoothed branch triplets per
+    candidate, exactly like spr.test_insert's thorough arm."""
+    seq_lnls, seq_es = [], []
+    for cand in plan.candidates:
+        q = cand.q_slot
+        r = q.back
+        qz = list(q.z)
+        pz = list(p.z)
+        spr.insert_node(inst, tree, ctx, p, q)     # triangle + smooth
+        seq_lnls.append(inst.evaluate(tree, p.next.next))
+        seq_es.append((p.next.z[0], p.next.next.z[0], p.z[0]))
+        hookup(q, r, qz)
+        p.next.back = None
+        p.next.next.back = None
+        hookup(p, p.back, pz)         # test_insert's thorough undo
+    return seq_lnls, seq_es
+
+
 @pytest.mark.slow
 def test_batched_thorough_matches_sequential():
     """The thorough arm (triangle NR + localSmooth + evaluate) batched
@@ -143,21 +162,7 @@ def test_batched_thorough_matches_sequential():
     plan = batchscan.plan_for_endpoints(inst, tree, p, q1, q2, 1, 4)
     assert plan is not None and len(plan.candidates) >= 3
     lnls, es = batchscan.run_plan_thorough(inst, tree, plan)
-
-    seq_lnls, seq_es = [], []
-    for cand in plan.candidates:
-        q = cand.q_slot
-        r = q.back
-        qz = list(q.z)
-        pz = list(p.z)
-        spr.insert_node(inst, tree, ctx, p, q)     # triangle + smooth
-        seq_lnls.append(inst.evaluate(tree, p.next.next))
-        seq_es.append((p.next.z[0], p.next.next.z[0], p.z[0]))
-        from examl_tpu.tree.topology import hookup as hk
-        hk(q, r, qz)
-        p.next.back = None
-        p.next.next.back = None
-        hk(p, p.back, pz)         # test_insert's thorough undo
+    seq_lnls, seq_es = _sequential_thorough(inst, tree, ctx, p, plan)
     np.testing.assert_allclose(lnls, seq_lnls, rtol=1e-9, atol=5e-4)
     np.testing.assert_allclose(es, seq_es, rtol=1e-3, atol=1e-5)
 
@@ -227,6 +232,41 @@ def test_batched_scan_matches_sequential_psr():
     batched = batchscan.run_plan(inst, tree, plan)
     sequential = _sequential_scores(inst, tree, ctx, p, plan)
     np.testing.assert_allclose(batched, sequential, rtol=1e-9, atol=1e-6)
+
+
+@pytest.mark.slow
+def test_batched_thorough_matches_sequential_psr():
+    """The THOROUGH batched arm under PSR (factorized per-site P in the
+    triangle Newton, localSmooth, and scoring) matches the sequential
+    insert->evaluate thorough loop."""
+    rng = np.random.default_rng(23)
+    names = [f"t{i}" for i in range(10)]
+    cur = rng.integers(0, 4, 280)
+    seqs = []
+    for _ in names:
+        flip = rng.random(280) < 0.25
+        cur = np.where(flip, rng.integers(0, 4, 280), cur)
+        seqs.append("".join("ACGT"[c] for c in cur))
+    ad = build_alignment_data(names, seqs)
+    inst = PhyloInstance(ad, rate_model="PSR")
+    tree = inst.random_tree(23)
+    inst.evaluate(tree, full=True)
+    from examl_tpu.optimize.psr import optimize_rate_categories
+    optimize_rate_categories(inst, tree)
+    inst.evaluate(tree, full=True)
+
+    ctx = spr.SprContext(inst, thorough=True, do_cutoff=False)
+    p = next(tree.nodep[n] for n in tree.inner_numbers()
+             if not tree.is_tip(tree.nodep[n].next.back.number)
+             and not tree.is_tip(tree.nodep[n].next.next.back.number))
+    q1, q2 = p.next.back, p.next.next.back
+    spr.remove_node(inst, tree, ctx, p)
+    plan = batchscan.plan_for_endpoints(inst, tree, p, q1, q2, 1, 4)
+    assert plan is not None and len(plan.candidates) >= 3
+    lnls, es = batchscan.run_plan_thorough(inst, tree, plan)
+    seq_lnls, seq_es = _sequential_thorough(inst, tree, ctx, p, plan)
+    np.testing.assert_allclose(lnls, seq_lnls, rtol=1e-9, atol=5e-4)
+    np.testing.assert_allclose(es, seq_es, rtol=1e-3, atol=1e-5)
 
 
 def test_deferred_restore_keeps_clvs_consistent():

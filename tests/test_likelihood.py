@@ -35,8 +35,6 @@ def test_lnl_matches_oracle_binary():
     """2-state (BIN) data end-to-end against the independent scipy-expm
     oracle — the morphological-data path (reference `BINARY_DATA`
     kernels, `newviewGenericSpecial.c:5871-6218`)."""
-    from examl_tpu.io.alignment import build_alignment_data
-
     rng = np.random.default_rng(9)
     names = [f"t{i}" for i in range(12)]
     cur = rng.integers(0, 2, 300)

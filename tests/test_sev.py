@@ -370,6 +370,68 @@ def test_sev_sharded_batched_scan_matches_single(monkeypatch):
 
 
 @pytest.mark.slow
+def test_sev_psr_matches_dense(gappy):
+    """-S under the PSR model (the reference allows -S with CAT; only
+    OMP/MIC/LG4/binary are excluded, axml.c:2640-2712): pooled lnL,
+    a rate-categorization round, and a batched SPR scan must all match
+    the dense PSR instance."""
+    from examl_tpu.optimize.psr import optimize_rate_categories
+    from examl_tpu.search import batchscan, spr
+
+    dense = PhyloInstance(gappy, rate_model="PSR")
+    sev = PhyloInstance(gappy, rate_model="PSR", save_memory=True)
+    out = {}
+    for inst in (dense, sev):
+        tree = inst.random_tree(9)
+        l0 = inst.evaluate(tree, full=True)
+        l1 = optimize_rate_categories(inst, tree)
+        ctx = spr.SprContext(inst, thorough=False, do_cutoff=False)
+        c = tree.centroid_branch()
+        p = c if not tree.is_tip(c.number) else c.back
+        q1, q2 = p.next.back, p.next.next.back
+        spr.remove_node(inst, tree, ctx, p)
+        plan = batchscan.plan_for_endpoints(inst, tree, p, q1, q2, 1, 4)
+        assert plan is not None
+        scans = batchscan.run_plan(inst, tree, plan)
+        out[inst is sev] = (l0, l1, scans)
+    assert out[True][0] == pytest.approx(out[False][0], rel=1e-12,
+                                         abs=1e-7)
+    assert out[True][1] == pytest.approx(out[False][1], rel=1e-12,
+                                         abs=1e-6)
+    np.testing.assert_allclose(out[True][2], out[False][2],
+                               rtol=1e-9, atol=1e-5)
+    (eng,) = sev.engines.values()
+    st = eng.sev.stats()
+    assert 0 < st["allocated_cells"] < st["dense_cells"]
+
+
+@pytest.mark.slow
+def test_sev_sharded_psr_matches_single():
+    """PSR x -S x 8-device sharding: the shard_mapped pooled programs
+    (site_rates sharded along the block axis) reproduce the
+    single-device PSR SEV lnL and rate optimization."""
+    from examl_tpu.optimize.psr import optimize_rate_categories
+    from examl_tpu.parallel.sharding import default_site_sharding
+
+    import tempfile
+    ad = _small_gappy_ad(tempfile.mkdtemp())
+    vals = []
+    for sharding in (None, default_site_sharding(8)):
+        inst = PhyloInstance(ad, rate_model="PSR", save_memory=True,
+                             sharding=sharding, block_multiple=8)
+        tree = inst.random_tree(3)
+        l0 = inst.evaluate(tree, full=True)
+        l1 = optimize_rate_categories(inst, tree)
+        z = inst.makenewz(tree, tree.nodep[5], tree.nodep[5].back,
+                          tree.nodep[5].z, maxiter=8)
+        vals.append((l0, l1, float(z[0])))
+    (a0, a1, az), (b0, b1, bz) = vals
+    assert b0 == pytest.approx(a0, rel=1e-12, abs=1e-7)
+    assert b1 == pytest.approx(a1, rel=1e-12, abs=1e-6)
+    assert bz == pytest.approx(az, rel=1e-10)
+
+
+@pytest.mark.slow
 def test_sev_batched_thorough_matches_dense(monkeypatch):
     """The batched THOROUGH arm (triangle Newton + localSmooth + score,
     one dispatch) on an -S SEV pool must reproduce the dense arena's
