@@ -167,6 +167,73 @@ def test_full_search_endpoint_matches_reference(tmp_path):
     assert rf <= 0.25, rf     # same neighborhood of tree space
 
 
+def _parse_quartet_file(path):
+    """{(frozenset{a,b}, frozenset{c,d}) -> lnL} keyed by taxon NAME
+    via the file's own 'Taxon names and indices' header."""
+    names = {}
+    quartets = {}
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            m = re.match(r"^(\S+) (\d+)$", line)
+            if m and "|" not in line:
+                names[int(m.group(2))] = m.group(1)
+                continue
+            m = re.match(r"^(\d+) (\d+) \| (\d+) (\d+): (-?\d+\.\d+)$",
+                         line)
+            if m:
+                a, b, c, d = (names[int(m.group(i))] for i in (1, 2, 3, 4))
+                key = frozenset([frozenset([a, b]), frozenset([c, d])])
+                quartets[key] = float(m.group(5))
+    return quartets
+
+
+@have_ref_binaries
+@pytest.mark.slow
+def test_quartets_match_reference(tmp_path):
+    """Live -f q parity with a -Y grouping (deterministic quartet set,
+    unlike -r's RNG-dependent sampling): every (pair | pair) topology's
+    lnL from the reference's quartet evaluator (`computeQuartets`,
+    `quartets.c:349-616`) must match ours.  Both sides optimize the
+    model independently first, so the comparison is
+    endpoint-vs-endpoint with a small tolerance."""
+    tmp = str(tmp_path)
+    subprocess.run([REF_PARSER, "-s", f"{TESTDATA}/49", "-q",
+                    f"{TESTDATA}/49.model", "-m", "DNA", "-n", "aln"],
+                   check=True, cwd=tmp, capture_output=True)
+    # The reference's groupingParser requires EVERY taxon assigned to
+    # one of the 4 groups and a ';' terminator (`quartets.c:148-152`).
+    from examl_tpu.io.alignment import load_alignment
+    data = load_alignment(f"{TESTDATA}/49", f"{TESTDATA}/49.model")
+    t = data.taxon_names
+    quarters = [t[i::4] for i in range(4)]
+    groups = str(tmp_path / "groups.txt")
+    with open(groups, "w") as f:
+        f.write(",".join("(" + ",".join(g) + ")" for g in quarters)
+                + ";\n")
+    out = os.path.join(tmp, "out")
+    os.makedirs(out, exist_ok=True)
+    subprocess.run([REF_EXAML, "-s", "aln.binary", "-t",
+                    f"{TESTDATA}/49.tree", "-m", "GAMMA", "-n", "RQ",
+                    "-f", "q", "-Y", groups, "-w", out + "/"],
+                   check=True, cwd=tmp, capture_output=True, timeout=3600)
+    ref_q = _parse_quartet_file(os.path.join(out, "ExaML_quartets.RQ"))
+    assert ref_q
+
+    from examl_tpu.cli.main import main as cli_main
+    ours_wd = str(tmp_path / "ours")
+    rc = cli_main(["-s", os.path.join(tmp, "aln.binary"), "-n", "OQ",
+                   "-t", f"{TESTDATA}/49.tree", "-f", "q", "-Y", groups,
+                   "-w", ours_wd])
+    assert rc == 0
+    our_q = _parse_quartet_file(os.path.join(ours_wd,
+                                             "ExaML_quartets.OQ"))
+    assert set(our_q) == set(ref_q)
+    for key in ref_q:
+        # independently-optimized model endpoints: small absolute slack
+        assert our_q[key] == pytest.approx(ref_q[key], abs=1.0), key
+
+
 @have_ref_binaries
 @pytest.mark.slow
 def test_tree_evaluation_matches_reference(tmp_path):
