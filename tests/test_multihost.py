@@ -230,6 +230,7 @@ import sys; sys.path.insert(0, {repo!r})
 import jax
 jax.distributed.initialize(coordinator_address="127.0.0.1:{port}",
                            num_processes=2, process_id={procid})
+from examl_tpu.config import enable_x64; enable_x64()
 from examl_tpu.io.bytefile import read_bytefile_for_process
 from examl_tpu.instance import PhyloInstance
 from examl_tpu.parallel.sharding import default_site_sharding
@@ -297,6 +298,91 @@ print("scan_lnls=", ",".join("%.6f" % float(v) for v in lnls))
 """
 
 
+def _sev_plan_reference(tmp_path, seed, thorough, maxtrav):
+    """Shared parent-side setup for the SEV batched-arm multihost
+    tests: whole-read -S instance, pruned centroid node, plan, and the
+    single-process reference scores."""
+    from examl_tpu.instance import PhyloInstance
+    from examl_tpu.search import batchscan, spr
+
+    data, bf = _gappy_two_gene_bytefile(tmp_path, seed=seed)
+    inst = PhyloInstance(data, save_memory=True)
+    tree = inst.random_tree(11)
+    treef = tmp_path / "t.nwk"
+    treef.write_text(tree.to_newick(data.taxon_names))
+    inst.evaluate(tree, full=True)
+    ctx = spr.SprContext(inst, thorough=thorough, do_cutoff=False)
+    c = tree.centroid_branch()
+    p = c if not tree.is_tip(c.number) else c.back
+    q1, q2 = p.next.back, p.next.next.back
+    saved = (p, list(q1.z), list(q2.z), q1, q2)
+    spr.remove_node(inst, tree, ctx, p)
+    plan = batchscan.plan_for_endpoints(inst, tree, p, q1, q2, 1,
+                                        maxtrav)
+    assert plan is not None and plan.candidates
+    if thorough:
+        ref = batchscan.run_plan_thorough(inst, tree, plan)
+    else:
+        ref = batchscan.run_plan(inst, tree, plan)
+    return inst, tree, bf, treef, saved, ref
+
+
+SEV_THOROUGH_CHILD = SEV_PREAMBLE + """
+import os as _os; _os.environ["EXAML_BATCH_THOROUGH"] = "1"
+from examl_tpu.search import batchscan, spr
+
+tree = inst.tree_from_newick(open({tree!r}).read())
+inst.evaluate(tree, full=True)
+assert spr.thorough_batched_ok(inst)
+ctx = spr.SprContext(inst, thorough=True, do_cutoff=False)
+c = tree.centroid_branch()
+p = c if not tree.is_tip(c.number) else c.back
+q1, q2 = p.next.back, p.next.next.back
+spr.remove_node(inst, tree, ctx, p)
+plan = batchscan.plan_for_endpoints(inst, tree, p, q1, q2, 1, 3)
+assert plan is not None
+lnls, es = batchscan.run_plan_thorough(inst, tree, plan)
+print("th_lnls=", ",".join("%.6f" % float(v) for v in lnls))
+print("th_es=", ",".join("%.8f" % float(v) for v in es.reshape(-1)))
+"""
+
+
+def test_multihost_sev_batched_thorough(tmp_path):
+    """The batched THOROUGH arm under -S with 2 REAL processes: the
+    on-device triangle/localSmooth Newton loops psum their derivatives
+    per iteration across the processes, so candidate lnLs AND the
+    smoothed branch triplets must agree exactly between processes and
+    match the whole-read single-process SEV run."""
+    _, _, bf, treef, _, (ref_lnls, ref_es) = _sev_plan_reference(
+        tmp_path, seed=27, thorough=True, maxtrav=3)
+
+    port = _free_port()
+    outs = _launch(
+        [SEV_THOROUGH_CHILD.format(repo=REPO, port=port, procid=p_,
+                                   bf=bf, tree=str(treef))
+         for p_ in range(2)],
+        ndev=4, timeout=900)
+    got = []
+    for out in outs:
+        lnls = [float(v) for v in
+                re.search(r"th_lnls= (\S+)", out).group(1).split(",")]
+        es = [float(v) for v in
+              re.search(r"th_es= (\S+)", out).group(1).split(",")]
+        got.append((lnls, es))
+    assert got[0] == got[1]
+    assert got[0][0] == pytest.approx([float(v) for v in ref_lnls],
+                                      abs=0.05)
+    # Branch triplets (children run f64 via the preamble's enable_x64):
+    # the only remaining difference vs the unsharded in-process
+    # reference is psum summation order, so agreement is tight except
+    # on near-ZMIN branches where the lnL is flat in z.
+    ref_flat = [float(v) for v in np.asarray(ref_es).reshape(-1)]
+    for ours, ref in zip(got[0][1], ref_flat):
+        if ref > 1e-3:           # one-sided: a near-ZMIN `ours` against
+            # a well-conditioned `ref` must FAIL, not be skipped
+            assert ours == pytest.approx(ref, rel=1e-4), (ours, ref)
+
+
 def test_multihost_sev_batched_scan(tmp_path):
     """The batched SPR radius scan under -S with 2 REAL processes: the
     scan region is carved from the sharded pool and the DENSE scaler
@@ -304,23 +390,9 @@ def test_multihost_sev_batched_scan(tmp_path):
     _grow_rows — eager concat with a process-local pad is undefined
     multi-process).  Candidate lnLs must agree across processes and
     match the whole-read single-process SEV scan."""
-    from examl_tpu.instance import PhyloInstance
-    from examl_tpu.search import batchscan, spr
-
-    data, bf = _gappy_two_gene_bytefile(tmp_path, seed=21)
-    inst = PhyloInstance(data, save_memory=True)   # whole-read reference
-    tree = inst.random_tree(11)
-    treef = tmp_path / "t.nwk"
-    treef.write_text(tree.to_newick(data.taxon_names))
-    inst.evaluate(tree, full=True)
-    ctx = spr.SprContext(inst, thorough=False, do_cutoff=False)
-    c = tree.centroid_branch()
-    p = c if not tree.is_tip(c.number) else c.back
-    q1, q2 = p.next.back, p.next.next.back
-    spr.remove_node(inst, tree, ctx, p)
-    plan = batchscan.plan_for_endpoints(inst, tree, p, q1, q2, 1, 4)
-    assert plan is not None
-    ref = [float(v) for v in batchscan.run_plan(inst, tree, plan)]
+    _, _, bf, treef, _, ref_scores = _sev_plan_reference(
+        tmp_path, seed=21, thorough=False, maxtrav=4)
+    ref = [float(v) for v in ref_scores]
 
     port = _free_port()
     outs = _launch(
