@@ -232,11 +232,16 @@ def _chained(step, n_steps):
 
 def _time_compiled(fn, clv, scaler, reps=3):
     """AOT-compile, pull XLA's FLOP count, then time `reps` executions;
-    returns (best_seconds, compile_seconds, flops_or_None)."""
+    returns (best_seconds, compile_seconds, flops_or_None).  Timing goes
+    through the obs dispatch-timer API — one definition of "dispatch
+    time" shared with tools/perf_lab.py, and every measurement lands in
+    the metrics registry that rides along in the BENCH artifact."""
     import jax
-    t0 = time.perf_counter()
-    compiled = fn.lower(clv, scaler).compile()
-    compile_s = time.perf_counter() - t0
+
+    from examl_tpu import obs
+    with obs.timer("bench.compile_s") as tm:
+        compiled = fn.lower(clv, scaler).compile()
+    compile_s = tm.elapsed
     flops = None
     try:
         cost = compiled.cost_analysis()
@@ -245,13 +250,9 @@ def _time_compiled(fn, clv, scaler, reps=3):
         flops = float(cost["flops"])
     except Exception:                            # noqa: BLE001
         pass
-    jax.block_until_ready(compiled(clv, scaler))   # warm
-    dt = None
-    for _ in range(reps):
-        t0 = time.perf_counter()
-        jax.block_until_ready(compiled(clv, scaler))
-        d = time.perf_counter() - t0
-        dt = d if dt is None or d < dt else dt
+    dt = obs.time_dispatch(
+        lambda: jax.block_until_ready(compiled(clv, scaler)),
+        reps=reps, warmup=1, name="bench.dispatch")
     return dt, compile_s, flops
 
 
@@ -512,6 +513,8 @@ def _stage_prims(state: _WorkerState) -> dict:
     end-to-end search time (reference stacks SURVEY §3.2-3.3); dispatch
     overhead is included on purpose.  Uses the engine's production tier
     selection (Pallas with runtime fallback on TPU)."""
+    from examl_tpu import obs
+
     inst, tree, eng, entries, dataset, lnl = state.small_state()
     out = {}
     inner = [tree.nodep[n] for n in tree.inner_numbers()
@@ -519,16 +522,17 @@ def _stage_prims(state: _WorkerState) -> dict:
     for p in inner:     # warm compile variants
         inst.evaluate(tree, p)
         inst.makenewz(tree, p, p.back, p.z, maxiter=16)
-    t0 = time.perf_counter()
-    for p in inner:
-        inst.evaluate(tree, p)
-    out["evaluate_ms"] = round(
-        (time.perf_counter() - t0) / len(inner) * 1000, 3)
-    t0 = time.perf_counter()
-    for p in inner:
-        inst.makenewz(tree, p, p.back, p.z, maxiter=16)
-    out["newton_branch_ms"] = round(
-        (time.perf_counter() - t0) / len(inner) * 1000, 3)
+    # evaluate/makenewz return host floats (already blocked); the obs
+    # timer is the shared stopwatch, same definition as perf_lab's.
+    dt = obs.time_dispatch(
+        lambda: [inst.evaluate(tree, p) for p in inner],
+        reps=1, warmup=0, name="bench.evaluate")
+    out["evaluate_ms"] = round(dt / len(inner) * 1000, 3)
+    dt = obs.time_dispatch(
+        lambda: [inst.makenewz(tree, p, p.back, p.z, maxiter=16)
+                 for p in inner],
+        reps=1, warmup=0, name="bench.newton_branch")
+    out["newton_branch_ms"] = round(dt / len(inner) * 1000, 3)
 
     from examl_tpu.search import batchscan, spr
     from examl_tpu.tree.topology import hookup
@@ -540,11 +544,10 @@ def _stage_prims(state: _WorkerState) -> dict:
     spr.remove_node(inst, tree, ctx, p)
     plan = batchscan.plan_for_endpoints(inst, tree, p, q1, q2, 1, 10)
     if plan is not None:                 # tip-locked window: no metric
-        batchscan.run_plan(inst, tree, plan)     # compile + warm
-        t0 = time.perf_counter()
-        batchscan.run_plan(inst, tree, plan)
-        out["spr_scan_ms_per_node"] = round(
-            (time.perf_counter() - t0) * 1000, 3)
+        dt = obs.time_dispatch(
+            lambda: batchscan.run_plan(inst, tree, plan),
+            reps=1, warmup=1, name="bench.spr_scan")   # warmup = compile
+        out["spr_scan_ms_per_node"] = round(dt * 1000, 3)
         out["spr_scan_candidates"] = len(plan.candidates)
     hookup(p.next, q1, p1z)
     hookup(p.next.next, q2, p2z)
@@ -610,6 +613,15 @@ def _worker(plan, best_hint: str) -> None:
                 pallas_invalid = True     # couldn't validate = invalid
         r["stage"] = sid
         print(json.dumps(r), flush=True)
+    # Ship this worker's metrics-registry snapshot to the parent so every
+    # BENCH artifact carries its cause attached (dispatch/compile/cache
+    # counters alongside the throughput numbers).
+    try:
+        from examl_tpu import obs
+        print(json.dumps({"stage": "__metrics__",
+                          "snapshot": obs.snapshot()}), flush=True)
+    except Exception:                            # noqa: BLE001
+        pass
 
 
 # ---------------------------------------------------------------------------
@@ -660,6 +672,29 @@ def _child_env(cpu: bool) -> dict:
     return env
 
 
+def _merge_metrics(results: dict, snapshot: dict) -> None:
+    """Accumulate a worker's metrics snapshot under results["__metrics__"]
+    (a killed worker may be resumed by a fresh one: counters sum, gauges
+    take the latest value, timers merge count/total)."""
+    acc = results.setdefault("__metrics__",
+                             {"counters": {}, "gauges": {}, "timers": {}})
+    for name, v in (snapshot.get("counters") or {}).items():
+        acc["counters"][name] = acc["counters"].get(name, 0) + v
+    acc["gauges"].update(snapshot.get("gauges") or {})
+    for name, t in (snapshot.get("timers") or {}).items():
+        cur = acc["timers"].get(name)
+        if cur is None:
+            acc["timers"][name] = dict(t)
+        else:
+            cur["count"] += t.get("count", 0)
+            cur["total_s"] += t.get("total_s", 0.0)
+            pairs = [(cur.get("min_s"), t.get("min_s"), min),
+                     (cur.get("max_s"), t.get("max_s"), max)]
+            for key, (a, b, pick) in zip(("min_s", "max_s"), pairs):
+                vals = [v for v in (a, b) if v is not None]
+                cur[key] = pick(vals) if vals else None
+
+
 def _parse_worker_output(out: str, results: dict, notes: list):
     """Collect stage JSON lines + ##start/##skip markers; return the id
     of a stage that was started but produced no line (i.e. hung)."""
@@ -676,7 +711,9 @@ def _parse_worker_output(out: str, results: dict, notes: list):
             except ValueError:
                 continue
             sid = d.pop("stage", None)
-            if sid:
+            if sid == "__metrics__":
+                _merge_metrics(results, d.get("snapshot") or {})
+            elif sid:
                 results[sid] = d
     for sid in started:
         if sid not in results:
@@ -723,7 +760,7 @@ def _orchestrate(cpu: bool, plan, results: dict, notes: list) -> None:
             out, err, timed_out = _text(e.stdout), _text(e.stderr), True
         if err:
             sys.stderr.write(err)
-        n_before = len(results)
+        n_before = len([k for k in results if k != "__metrics__"])
         hung = _parse_worker_output(out, results, notes)
         bests = [(r["ups"], r["variant"]) for sid, r in results.items()
                  if sid.startswith("s-") and "ups" in r]
@@ -739,7 +776,7 @@ def _orchestrate(cpu: bool, plan, results: dict, notes: list) -> None:
             results[hung] = {"error": "stage deadline exceeded (killed)"}
             notes.append(f"stage {hung} hung; killed worker")
             plan = [s for s in plan if s != hung]
-        elif len(results) == n_before:
+        elif len([k for k in results if k != "__metrics__"]) == n_before:
             # Worker wedged before its first ##start marker (backend
             # init): retrying the identical plan would burn the budget
             # attempt by attempt.
@@ -844,6 +881,12 @@ def _assemble(results: dict, notes: list, cpu_fallback: bool) -> str:
     doc["baseline_source"] = base_src
     doc["backend"] = backend if backend != "unknown" else (
         "cpu" if cpu_fallback else "unknown")
+    # The workers' merged metrics-registry snapshot: every BENCH artifact
+    # carries its dispatch/compile/cache counters so a perf regression
+    # arrives with its cause attached (e.g. an eviction storm or a
+    # Pallas fallback shows up right next to the slower number).
+    if "__metrics__" in results:
+        doc["metrics"] = results["__metrics__"]
     if notes:
         doc["note"] = "; ".join(notes)
     return json.dumps(doc)

@@ -68,6 +68,14 @@ def build_argparser() -> argparse.ArgumentParser:
     ap.add_argument("--profile", dest="profile_dir", default=None,
                     help="write a jax profiler trace to this directory "
                          "(SURVEY §5.1; view with xprof/tensorboard)")
+    ap.add_argument("--metrics", dest="metrics_file", default=None,
+                    help="write the runtime metrics-registry snapshot "
+                         "(dispatch/compile/cache counters, phase timers) "
+                         "to this JSON file at exit (process 0 only)")
+    ap.add_argument("--trace-events", dest="trace_events_dir", default=None,
+                    help="write Chrome-trace/Perfetto span events to "
+                         "per-process JSONL files in this directory "
+                         "(trace.p<procid>.jsonl; open in ui.perfetto.dev)")
     ap.add_argument("-g", dest="constraint_file", default=None,
                     help="multifurcating constraint tree")
     ap.add_argument("-p", dest="seed", type=int, default=12345,
@@ -123,27 +131,43 @@ class RunFiles:
             f.write(msg + "\n")
 
     # -- per-phase wall-time accounting (SURVEY §5.1: the reference has
-    # only gettime()/accumulatedTime; phase times in ExaML_info are the
-    # first-class observability the survey flags as missing) -------------
+    # only gettime()/accumulatedTime; phase times feed the metrics
+    # registry as `phase.<name>` timers and emit trace spans, so the
+    # info-file report, --metrics, and --trace-events share one record) --
 
     @contextlib.contextmanager
     def phase(self, name: str):
+        from examl_tpu import obs
         t0 = time.time()
         try:
-            yield
+            with obs.span(f"phase:{name}", cat="phase"):
+                yield
         finally:
-            self._phases[name] = self._phases.get(name, 0.0) \
-                + time.time() - t0
+            dt = time.time() - t0
+            self._phases[name] = self._phases.get(name, 0.0) + dt
+            obs.observe(f"phase.{name}", dt)
 
     def report_phases(self) -> None:
-        phases = self._phases
+        # This instance's phases, merged with any `phase.*` timers other
+        # components recorded straight into the registry.
+        phases = dict(self._phases)
+        try:
+            from examl_tpu import obs
+            for name, t in obs.snapshot().get("timers", {}).items():
+                if name.startswith("phase.") and name[6:] not in phases:
+                    phases[name[6:]] = t["total_s"]
+        except Exception:
+            pass
         if not phases:
             return
         total = time.time() - self.start_time
         self.info("")
         self.info("Wall-clock by phase:")
         for name, dt in phases.items():
-            self.info(f"  {name:24s} {dt:10.2f} s  ({100*dt/total:5.1f}%)")
+            # Guard total == 0: a run whose phases are all ~0 s (mocked
+            # clocks, sub-tick runs) must report, not ZeroDivisionError.
+            pct = 100.0 * dt / total if total > 0 else 0.0
+            self.info(f"  {name:24s} {dt:10.2f} s  ({pct:5.1f}%)")
         self.info(f"  {'total':24s} {total:10.2f} s")
 
     def log_lnl(self, lnl: float) -> None:
@@ -478,9 +502,13 @@ def main(argv=None) -> int:
         ap.error('you must specify either "-r randomQuartetNumber" or '
                  '"-Y quartetGroupingFileName"')
 
-    from examl_tpu.instance import PhyloInstance
-    from examl_tpu.parallel.launch import init_distributed, select_sharding
+    from examl_tpu import obs
+    from examl_tpu.parallel.launch import (enable_process_tracing,
+                                           init_distributed)
 
+    # One run = one metrics record: callers invoking main() repeatedly in
+    # a single process (tests) must not accumulate counters across runs.
+    obs.reset()
     # Join the multi-host job BEFORE any output: only process 0 writes
     # run files (the reference's processID==0 gating); other processes
     # compute the same SPMD program with their files diverted to a
@@ -495,6 +523,41 @@ def main(argv=None) -> int:
                                         f".proc{jax.process_index()}")
     files = RunFiles(args.workdir, args.run_id, append=args.restart,
                      primary=primary)
+    # Observability wiring: per-process trace files named by procid
+    # (process 0 merges a summary at exit), TraceAnnotation scopes when
+    # any tracer is active, and the operator log sink into the info file
+    # so watchdog barks name the guilty program family there too.
+    if args.trace_events_dir:
+        enable_process_tracing(args.trace_events_dir, log=files.info)
+    if args.profile_dir or args.trace_events_dir:
+        obs.set_annotations(True)
+    obs.set_log_sink(files.info)
+    try:
+        return _run(args, files)
+    finally:
+        # The metrics snapshot and trace finalize must survive FAILED
+        # runs — a wedged compile or mid-search crash is exactly when
+        # the counters and the last completed span matter (the round-4
+        # postmortem this subsystem exists for).
+        if args.metrics_file and files.primary:
+            import json
+
+            try:
+                with open(args.metrics_file, "w") as f:
+                    json.dump(obs.snapshot(), f, indent=2, sort_keys=True,
+                              default=str)
+                files.info(f"metrics snapshot -> {args.metrics_file}")
+            except OSError as exc:
+                files.info(f"metrics snapshot failed ({exc})")
+        obs.set_log_sink(None)       # don't leak this run's info file
+        obs.set_annotations(False)   # no TraceAnnotation cost after the run
+        obs.finalize_tracing()
+
+
+def _run(args, files: RunFiles) -> int:
+    from examl_tpu.instance import PhyloInstance
+    from examl_tpu.parallel.launch import select_sharding
+
     files.info("examl-tpu: TPU-native maximum likelihood inference "
                "(capability parity with ExaML 3.0.22)")
     files.info(f"alignment: {args.bytefile}  mode: -f {args.mode}  "

@@ -1,0 +1,96 @@
+"""examl_tpu.obs — unified runtime observability.
+
+Three dependency-free pieces (SURVEY §5.1/§5.5: the reference's only
+instruments are gettime() deltas and ExaML_info prints):
+
+* a process-wide **metrics registry** (`obs.metrics`): counters, gauges,
+  timers — always on, dict-update cheap;
+* a **span tracer** (`obs.trace`): Chrome-trace/Perfetto-compatible
+  per-process JSONL files, off unless `--trace-events` /
+  `EXAML_TRACE_DIR` enables it, with `jax.profiler.TraceAnnotation`
+  scopes so host spans line up with device profiles;
+* a shared **dispatch-timing helper** (`obs.timing`) so bench.py and
+  tools/perf_lab.py measure "dispatch time" identically.
+
+This module is the flat facade the rest of the runtime imports:
+
+    from examl_tpu import obs
+    obs.inc("engine.dispatch_count")
+    with obs.device_span("engine:traverse", args={"entries": n}):
+        ...
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import Callable, Optional
+
+from examl_tpu.obs import metrics as _metrics
+from examl_tpu.obs import trace as _trace
+from examl_tpu.obs.timing import time_dispatch  # noqa: F401
+from examl_tpu.obs.trace import (  # noqa: F401
+    device_span, enable as enable_tracing, enabled as tracing_enabled,
+    finalize as finalize_tracing, instant, merge_summary, read_events,
+    set_annotations, span)
+
+# -- metrics facade ---------------------------------------------------------
+
+
+def registry() -> _metrics.MetricsRegistry:
+    return _metrics.registry()
+
+
+def inc(name: str, value: float = 1) -> None:
+    _metrics.registry().inc(name, value)
+
+
+def counter(name: str) -> float:
+    return _metrics.registry().counter(name)
+
+
+def gauge(name: str, value: float) -> None:
+    _metrics.registry().gauge(name, value)
+
+
+def observe(name: str, seconds: float) -> None:
+    _metrics.registry().observe(name, seconds)
+
+
+def timer(name: str):
+    return _metrics.registry().timer(name)
+
+
+def add_collector(fn: Callable[[], bool]) -> None:
+    _metrics.registry().add_collector(fn)
+
+
+def snapshot() -> dict:
+    return _metrics.registry().snapshot()
+
+
+def reset() -> None:
+    _metrics.registry().reset()
+
+
+# -- operator log sink ------------------------------------------------------
+# Runtime components that must reach the operator (the compile watchdog)
+# write through here: always stderr, plus whatever sink the driver
+# installed (the CLI points this at the ExaML_info file so a wedged run's
+# info file names the guilty program family).
+
+_log_sink: Optional[Callable[[str], None]] = None
+
+
+def set_log_sink(fn: Optional[Callable[[str], None]]) -> None:
+    global _log_sink
+    _log_sink = fn
+
+
+def log(msg: str) -> None:
+    sys.stderr.write(msg + "\n")
+    sink = _log_sink
+    if sink is not None:
+        try:
+            sink(msg)
+        except Exception:
+            pass

@@ -1,0 +1,158 @@
+"""Process-wide metrics registry: counters, gauges, timers.
+
+The runtime's single source of numeric truth (SURVEY §5.5: the reference
+has only ExaML_info prints and gettime() deltas).  Everything here is
+stdlib-only and always on — a counter increment is a dict update under a
+lock, negligible against the millisecond-scale device dispatches it
+counts — while the *expensive* instruments (trace events, device-array
+gauges) stay behind explicit opt-ins (`obs.trace`, snapshot collectors).
+
+Naming convention (dotted, lowercase):
+
+  engine.dispatch_count        device program invocations
+  engine.traversal_entries     newview entries submitted (retraversal size)
+  engine.cache_hits/misses/evictions   shared fast-program LRU
+  engine.compile_count, engine.compile_seconds[.family]
+  engine.pallas_fallbacks      Mosaic -> XLA demotions
+  engine.watchdog_barks        >180 s compile watchdog firings
+  search.spr_cycles, search.fast_cycles, search.thorough_cycles
+  search.scan_dispatches, search.scan_candidates
+  phase.<name>                 CLI wall-clock phases (timers)
+
+Counters accept float increments (compile_seconds accumulates wall
+seconds); timers record count/total/min/max of observed durations.
+Snapshot collectors let owners of live state (engines) publish gauges
+lazily — they run only when `snapshot()` is taken, so per-call cost is
+zero, and they hold weak references so a registry never keeps a CLV
+arena alive.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, Optional
+
+
+class TimerStat:
+    __slots__ = ("count", "total", "min", "max")
+
+    def __init__(self) -> None:
+        self.count = 0
+        self.total = 0.0
+        self.min: Optional[float] = None
+        self.max: Optional[float] = None
+
+    def observe(self, seconds: float) -> None:
+        self.count += 1
+        self.total += seconds
+        self.min = seconds if self.min is None else min(self.min, seconds)
+        self.max = seconds if self.max is None else max(self.max, seconds)
+
+    def as_dict(self) -> dict:
+        return {"count": self.count, "total_s": self.total,
+                "min_s": self.min, "max_s": self.max}
+
+
+class _TimerContext:
+    """Context manager that observes its own wall duration into a timer;
+    exposes `.elapsed` (seconds) after exit so callers can reuse the one
+    measurement instead of re-bracketing with perf_counter."""
+
+    __slots__ = ("_registry", "_name", "_t0", "elapsed")
+
+    def __init__(self, registry: "MetricsRegistry", name: str) -> None:
+        self._registry = registry
+        self._name = name
+        self.elapsed = 0.0
+
+    def __enter__(self) -> "_TimerContext":
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.elapsed = time.perf_counter() - self._t0
+        self._registry.observe(self._name, self.elapsed)
+
+
+class MetricsRegistry:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._counters: Dict[str, float] = {}
+        self._gauges: Dict[str, float] = {}
+        self._timers: Dict[str, TimerStat] = {}
+        self._collectors: list = []
+
+    # -- counters / gauges / timers ----------------------------------------
+
+    def inc(self, name: str, value: float = 1) -> None:
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0) + value
+
+    def counter(self, name: str) -> float:
+        with self._lock:
+            return self._counters.get(name, 0)
+
+    def gauge(self, name: str, value: float) -> None:
+        with self._lock:
+            self._gauges[name] = value
+
+    def observe(self, name: str, seconds: float) -> None:
+        with self._lock:
+            stat = self._timers.get(name)
+            if stat is None:
+                stat = self._timers[name] = TimerStat()
+            stat.observe(seconds)
+
+    def timer(self, name: str) -> _TimerContext:
+        return _TimerContext(self, name)
+
+    # -- collectors ---------------------------------------------------------
+
+    def add_collector(self, fn: Callable[[], bool]) -> None:
+        """Register a zero-arg callable run at every snapshot().  It may
+        set gauges; returning False (or raising) unregisters it — the
+        idiom for weakref-bound owners that have been collected."""
+        with self._lock:
+            self._collectors.append(fn)
+
+    def _run_collectors(self) -> None:
+        with self._lock:
+            collectors = list(self._collectors)
+        dead = []
+        for fn in collectors:
+            try:
+                if fn() is False:
+                    dead.append(fn)
+            except Exception:
+                dead.append(fn)
+        if dead:
+            with self._lock:
+                self._collectors = [c for c in self._collectors
+                                    if c not in dead]
+
+    # -- snapshot / reset ---------------------------------------------------
+
+    def snapshot(self) -> dict:
+        self._run_collectors()
+        with self._lock:
+            return {
+                "counters": dict(self._counters),
+                "gauges": dict(self._gauges),
+                "timers": {n: t.as_dict() for n, t in self._timers.items()},
+            }
+
+    def reset(self) -> None:
+        """Clear counters/gauges/timers (collectors stay registered —
+        their owners are still live)."""
+        with self._lock:
+            self._counters.clear()
+            self._gauges.clear()
+            self._timers.clear()
+
+
+_REGISTRY = MetricsRegistry()
+
+
+def registry() -> MetricsRegistry:
+    return _REGISTRY

@@ -1,0 +1,35 @@
+"""The one definition of "dispatch time" shared by bench.py and the perf
+lab: warm-up calls, then best-of-N wall seconds around a blocking call,
+every repetition observed into the metrics registry so lab and bench
+numbers are the same measurement with different report formats.
+
+The callable must itself block until the device work is done (wrap the
+dispatch in `jax.block_until_ready`); this module stays jax-free so the
+obs package imports without a backend.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable
+
+from examl_tpu.obs import metrics as _metrics
+
+
+def time_dispatch(call: Callable[[], object], *, reps: int = 1,
+                  warmup: int = 1, name: str = "dispatch") -> float:
+    """Best wall seconds of `reps` timed invocations of `call()` after
+    `warmup` untimed ones.  Each timed repetition is observed into the
+    registry timer `name`."""
+    reg = _metrics.registry()
+    for _ in range(warmup):
+        call()
+    best = None
+    for _ in range(max(1, reps)):
+        t0 = time.perf_counter()
+        call()
+        dt = time.perf_counter() - t0
+        reg.observe(name, dt)
+        if best is None or dt < best:
+            best = dt
+    return best

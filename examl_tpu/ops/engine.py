@@ -22,6 +22,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from examl_tpu import obs
 from examl_tpu.models.gtr import ModelParams
 from examl_tpu.ops import kernels
 from examl_tpu.ops.kernels import DeviceModels, Traversal
@@ -81,6 +82,8 @@ def _bucket_len(n: int) -> int:
 
 
 class LikelihoodEngine:
+    _obs_seq = 0                 # gauge-name ordinal (see _register_obs)
+
     def __init__(self, bucket: PackedBucket, models: Sequence[ModelParams],
                  ntips: int, num_branch_slots: int = 1,
                  branch_indices: Optional[Sequence[int]] = None,
@@ -320,6 +323,69 @@ class LikelihoodEngine:
             self._jit_sumtable = jax.jit(self._sumtable_impl)
             self._jit_derivs = jax.jit(self._derivs_impl)
         self._jit_rate_scan = jax.jit(self._rate_scan_impl)
+        # Core programs get the same timed/watchdogged first-call monitor
+        # as the shared-cache fast programs: any program family's compile
+        # can wedge the remote tunnel, so every family must be able to
+        # name itself from the watchdog and account its compile seconds.
+        for attr, family in (("_jit_traverse", "traverse"),
+                             ("_jit_evaluate", "evaluate"),
+                             ("_jit_trav_eval", "trav_eval"),
+                             ("_jit_newton", "newton"),
+                             ("_jit_sumtable", "sumtable"),
+                             ("_jit_derivs", "derivs"),
+                             ("_jit_rate_scan", "rate_scan")):
+            setattr(self, attr, self._guard_first_call(getattr(self, attr),
+                                                       family))
+        self._register_obs()
+
+    # -- observability ------------------------------------------------------
+
+    def _register_obs(self) -> None:
+        """Publish this engine's gauges into the process metrics registry
+        via a weakref-bound snapshot collector (ISSUE: CLV arena bytes,
+        rescale counts) — zero per-dispatch cost, the device is touched
+        only when a snapshot is taken."""
+        import weakref
+
+        obs.inc("engine.instances")
+        # Unique per engine: two same-state engines (bench builds several
+        # K=4 instances in one process) must not alias each other's
+        # gauges — the ordinal disambiguates.
+        seq = LikelihoodEngine._obs_seq
+        LikelihoodEngine._obs_seq += 1
+        self._obs_tag = f"s{self.K}.e{seq}"
+        self._update_arena_gauge()
+        ref = weakref.ref(self)
+
+        def _collect():
+            eng = ref()
+            if eng is None:
+                return False
+            eng._update_arena_gauge()
+            try:
+                # Total accumulated scaling counts across the arena — the
+                # host-visible residue of on-device rescale events.  Only
+                # safe single-process: a one-sided reduction over a
+                # multi-process global array would hang the job.
+                if eng.sharding is None and eng.scaler is not None:
+                    obs.gauge("engine.rescale_scale_counts." + eng._obs_tag,
+                              int(jnp.sum(eng.scaler)))
+            except Exception:
+                pass
+            return True
+
+        obs.add_collector(_collect)
+
+    def _update_arena_gauge(self) -> None:
+        itemsize = np.dtype(self.storage_dtype).itemsize
+        if self.clv is not None:
+            nbytes = (self.num_rows * self.B * self.lane * self.R
+                      * self.K * itemsize)
+        elif self.sev is not None and self.sev.pool is not None:
+            nbytes = int(np.prod(self.sev.pool.shape)) * itemsize
+        else:
+            nbytes = 0
+        obs.gauge("engine.clv_arena_bytes." + self._obs_tag, nbytes)
 
     def _sev_spec_vocab(self) -> dict:
         """PartitionSpec vocabulary + shard_map wrapper for the SEV x
@@ -548,6 +614,9 @@ class LikelihoodEngine:
         post-donation runtime fault leaves the arena deleted and the
         retry will surface it."""
         import warnings
+        obs.inc("engine.pallas_fallbacks")
+        obs.instant("pallas_fallback",
+                    args={"error": f"{type(exc).__name__}: {exc}"[:300]})
         warnings.warn(
             "EXAML: Pallas kernel dispatch failed (%s: %s); permanently "
             "falling back to the XLA fast path for this engine. Set "
@@ -561,36 +630,47 @@ class LikelihoodEngine:
                       full: bool = False) -> None:
         if not entries:
             return
-        if full and self._fast_eligible(entries):
-            try:
-                self._run_fast_traversal(entries)
-                self._pallas_proven = self.use_pallas
-            except Exception as exc:           # Mosaic lowering/compile
-                if not self.use_pallas or self._pallas_proven:
-                    raise
-                self._pallas_failed(exc)
-                self._run_fast_traversal(entries)
-            return
-        if self.save_memory:
-            self._sev_begin(entries)
-        tv = self._traversal_arrays(entries)
-        buf, aux = self._state()
-        buf, self.scaler = self._jit_traverse(
-            buf, self.scaler, aux, tv, self.models, self.block_part,
-            self.tips, self.site_rates)
-        self._set_buf(buf)
+        obs.inc("engine.dispatch_count")
+        obs.inc("engine.traversal_entries", len(entries))
+        with obs.device_span("engine:traverse",
+                             args={"entries": len(entries),
+                                   "full": bool(full)}):
+            if full and self._fast_eligible(entries):
+                try:
+                    self._run_fast_traversal(entries)
+                    self._pallas_proven = self.use_pallas
+                except Exception as exc:       # Mosaic lowering/compile
+                    if not self.use_pallas or self._pallas_proven:
+                        raise
+                    self._pallas_failed(exc)
+                    self._run_fast_traversal(entries)
+                return
+            if self.save_memory:
+                self._sev_begin(entries)
+            tv = self._traversal_arrays(entries)
+            buf, aux = self._state()
+            buf, self.scaler = self._jit_traverse(
+                buf, self.scaler, aux, tv, self.models, self.block_part,
+                self.tips, self.site_rates)
+            self._set_buf(buf)
 
-    def _guard_first_call(self, fn):
+    def _guard_first_call(self, fn, family: str = "program"):
         """Wrap a freshly-jitted program so its FIRST invocation (= the
-        compile) runs under a watchdog: on the axon/TPU remote-compile
-        tunnel a pathological compile blocks in recv with no
-        Python-level recourse (observed round 4: the chunk program never
-        returned), so after 180 s a daemon thread tells the user which
-        escape hatch pins the hardware-proven scan tier.  Compile
-        happens in C++ with the GIL released, so the timer thread does
-        run while the main thread is stuck.  Installed at every
-        fast-program cache miss, so recompiles after a Mosaic-failure
-        fallback (or LRU eviction) are guarded too."""
+        compile) runs as a timed, event-emitting compile monitor: on the
+        axon/TPU remote-compile tunnel a pathological compile blocks in
+        recv with no Python-level recourse (observed round 4: the chunk
+        program never returned), so after 180 s a daemon thread tells
+        the user WHICH program family is stuck and which escape hatch
+        pins the hardware-proven scan tier — through stderr AND the run
+        info file (obs log sink), so the operator need not guess.
+        Compile happens in C++ with the GIL released, so the timer
+        thread does run while the main thread is stuck.  Installed at
+        every fast-program cache miss, so recompiles after a
+        Mosaic-failure fallback (or LRU eviction) are guarded too.  The
+        first call is counted and timed into the registry
+        (engine.compile_count / engine.compile_seconds[.family]) and
+        emits a `compile:<family>` span — a wedged compile leaves the
+        span's unmatched "B" event as the trace's last line."""
         state = {"first": True}
 
         def call(*args):
@@ -598,27 +678,43 @@ class LikelihoodEngine:
                 return fn(*args)
             state["first"] = False
             import threading
+            import time as _time
 
             done = threading.Event()
 
             def bark():
                 if not done.wait(180.0):
-                    import sys
-                    sys.stderr.write(
-                        "EXAML: a device-program compile has taken >180s "
-                        "— if this never returns, rerun with "
-                        "EXAML_FAST_TRAVERSAL=0 (scan tier), "
-                        "EXAML_PALLAS=0, or EXAML_BATCH_SCAN=0 "
-                        "(sequential SPR scans), depending on which "
-                        "program is compiling.\n")
+                    obs.inc("engine.watchdog_barks")
+                    obs.log(
+                        "EXAML: a device-program compile (program family "
+                        f"'{family}') has taken >180s — if this never "
+                        "returns, rerun with EXAML_FAST_TRAVERSAL=0 "
+                        "(scan tier), EXAML_PALLAS=0, or "
+                        "EXAML_BATCH_SCAN=0 (sequential SPR scans), "
+                        "depending on which program is compiling.")
 
             threading.Thread(target=bark, daemon=True).start()
+            t0 = _time.perf_counter()
             try:
-                return fn(*args)
+                with obs.span(f"compile:{family}", cat="compile"):
+                    return fn(*args)
             finally:
                 done.set()
+                dt = _time.perf_counter() - t0
+                obs.inc("engine.compile_count")
+                obs.inc("engine.compile_seconds", dt)
+                obs.inc(f"engine.compile_seconds.{family}", dt)
 
         return call
+
+    @staticmethod
+    def _cache_family(key) -> str:
+        """Program family of a shared-cache key: external builders prefix
+        their keys with a string tag ("scan"/"thscan"/"whole"/...); the
+        engine's own chunk-profile keys are the "fast" family."""
+        if isinstance(key, tuple) and key and isinstance(key[0], str):
+            return key[0]
+        return "fast"
 
     # -- shared program cache (LRU) -----------------------------------------
     # External program builders (search/batchscan.py, quartets_batch.py)
@@ -632,13 +728,17 @@ class LikelihoodEngine:
         fn = self._fast_jit_cache.get(key)
         if fn is not None:
             self._fast_jit_cache.move_to_end(key)
+            obs.inc("engine.cache_hits")
+        else:
+            obs.inc("engine.cache_misses")
         return fn
 
     def cache_put(self, key, fn):
-        fn = self._guard_first_call(fn)
+        fn = self._guard_first_call(fn, self._cache_family(key))
         self._fast_jit_cache[key] = fn
         while len(self._fast_jit_cache) > self._fast_jit_cache_cap:
             self._fast_jit_cache.popitem(last=False)
+            obs.inc("engine.cache_evictions")
         return fn
 
     def _run_fast_traversal(self, entries: List[TraversalEntry]) -> None:
@@ -922,6 +1022,9 @@ class LikelihoodEngine:
         from the pool by ensure_scan_rows)."""
         from examl_tpu.search import batchscan
 
+        obs.inc("engine.dispatch_count")
+        obs.inc("engine.traversal_entries",
+                len(plan.down_entries) + len(plan.up_entries))
         if self.save_memory:
             self.sev.update_for_entries(plan.down_entries)
         base = self.ensure_scan_rows(len(plan.up_entries))
@@ -936,14 +1039,17 @@ class LikelihoodEngine:
         fn = batchscan.scan_program(self, n_chunks)
         zp = jnp.asarray(_z_slots(plan.zp, C), dtype=self.dtype)
         buf, aux = self._state()
-        buf, self.scaler, lnls = fn(
-            buf, self.scaler, aux, tv,
-            jnp.asarray(qg.reshape(n_chunks, T)),
-            jnp.asarray(upg.reshape(n_chunks, T)),
-            jnp.asarray(zc.reshape(n_chunks, T, C), dtype=self.dtype),
-            jnp.int32(self._gidx(plan.s_num)), zp,
-            self.models, self.block_part, self.weights, self.tips,
-            self.site_rates)
+        with obs.device_span("engine:spr_scan",
+                             args={"candidates": len(plan.candidates),
+                                   "chunks": n_chunks}):
+            buf, self.scaler, lnls = fn(
+                buf, self.scaler, aux, tv,
+                jnp.asarray(qg.reshape(n_chunks, T)),
+                jnp.asarray(upg.reshape(n_chunks, T)),
+                jnp.asarray(zc.reshape(n_chunks, T, C), dtype=self.dtype),
+                jnp.int32(self._gidx(plan.s_num)), zp,
+                self.models, self.block_part, self.weights, self.tips,
+                self.site_rates)
         self._set_buf(buf)
         return np.asarray(lnls)[:len(plan.candidates)]
 
@@ -955,6 +1061,9 @@ class LikelihoodEngine:
         arm."""
         from examl_tpu.search import batchscan
 
+        obs.inc("engine.dispatch_count")
+        obs.inc("engine.traversal_entries",
+                len(plan.down_entries) + len(plan.up_entries))
         if self.save_memory:
             self.sev.update_for_entries(plan.down_entries)
         base = self.ensure_scan_rows(len(plan.up_entries))
@@ -967,13 +1076,16 @@ class LikelihoodEngine:
             zq0[i] = float(np.asarray(c.q_slot.z, np.float64)[0])
         fn = batchscan.thorough_program(self, n_chunks)
         buf, aux = self._state()
-        buf, self.scaler, lnls, es = fn(
-            buf, self.scaler, aux, tv,
-            jnp.asarray(qg.reshape(n_chunks, T)),
-            jnp.asarray(upg.reshape(n_chunks, T)),
-            jnp.asarray(zq0.reshape(n_chunks, T), dtype=self.dtype),
-            jnp.int32(self._gidx(plan.s_num)), self.models,
-            self.block_part, self.weights, self.tips, self.site_rates)
+        with obs.device_span("engine:spr_thorough",
+                             args={"candidates": len(plan.candidates),
+                                   "chunks": n_chunks}):
+            buf, self.scaler, lnls, es = fn(
+                buf, self.scaler, aux, tv,
+                jnp.asarray(qg.reshape(n_chunks, T)),
+                jnp.asarray(upg.reshape(n_chunks, T)),
+                jnp.asarray(zq0.reshape(n_chunks, T), dtype=self.dtype),
+                jnp.int32(self._gidx(plan.s_num)), self.models,
+                self.block_part, self.weights, self.tips, self.site_rates)
         self._set_buf(buf)
         N = len(plan.candidates)
         return np.asarray(lnls)[:N], np.asarray(es)[:N]
@@ -1017,13 +1129,16 @@ class LikelihoodEngine:
 
     def evaluate(self, p_num: int, q_num: int, z: Sequence[float]) -> np.ndarray:
         """Per-partition lnL [M] at branch (p,q); CLVs must be current."""
+        obs.inc("engine.dispatch_count")
         zv = jnp.asarray(_z_slots(z, self.num_branch_slots), dtype=self.dtype)
         buf, aux = self._state()
-        out = self._jit_evaluate(buf, self.scaler, aux,
-                                 jnp.int32(self._gidx(p_num)),
-                                 jnp.int32(self._gidx(q_num)),
-                                 zv, self.models, self.block_part,
-                                 self.weights, self.tips, self.site_rates)
+        with obs.device_span("engine:evaluate"):
+            out = self._jit_evaluate(buf, self.scaler, aux,
+                                     jnp.int32(self._gidx(p_num)),
+                                     jnp.int32(self._gidx(q_num)),
+                                     zv, self.models, self.block_part,
+                                     self.weights, self.tips,
+                                     self.site_rates)
         return np.asarray(out)
 
     # -- fused single-dispatch entry points ---------------------------------
@@ -1043,6 +1158,16 @@ class LikelihoodEngine:
     def traverse_evaluate(self, entries: List[TraversalEntry], p_num: int,
                           q_num: int, z: Sequence[float],
                           full: bool = False) -> np.ndarray:
+        obs.inc("engine.dispatch_count")
+        obs.inc("engine.traversal_entries", len(entries))
+        with obs.device_span("engine:trav_eval",
+                             args={"entries": len(entries),
+                                   "full": bool(full)}):
+            return self._traverse_evaluate(entries, p_num, q_num, z, full)
+
+    def _traverse_evaluate(self, entries: List[TraversalEntry], p_num: int,
+                           q_num: int, z: Sequence[float],
+                           full: bool = False) -> np.ndarray:
         if full and entries and self._fast_eligible(entries):
             try:
                 out = self._trav_eval_fast(entries, p_num, q_num, z)
@@ -1110,6 +1235,9 @@ class LikelihoodEngine:
                       q_num: int, z0: np.ndarray, maxiter: int,
                       conv_mask: Optional[np.ndarray] = None) -> np.ndarray:
         """Fused traversal + sumtable + NR-to-convergence; returns new z [C]."""
+        obs.inc("engine.dispatch_count")
+        obs.inc("engine.newton_dispatches")
+        obs.inc("engine.traversal_entries", len(entries))
         if self.save_memory:
             self._sev_begin(entries)
         tv = self._traversal_arrays(entries)
@@ -1117,12 +1245,15 @@ class LikelihoodEngine:
         if conv_mask is None:
             conv_mask = np.zeros(C, dtype=bool)
         buf, aux = self._state()
-        buf, self.scaler, z = self._jit_newton(
-            buf, self.scaler, aux, tv, jnp.int32(self._gidx(p_num)),
-            jnp.int32(self._gidx(q_num)), jnp.asarray(z0),
-            jnp.full(C, maxiter, dtype=jnp.int32), jnp.asarray(conv_mask),
-            self.models, self.block_part, self.weights, self.tips,
-            self.site_rates)
+        with obs.device_span("engine:newton",
+                             args={"entries": len(entries),
+                                   "maxiter": int(maxiter)}):
+            buf, self.scaler, z = self._jit_newton(
+                buf, self.scaler, aux, tv, jnp.int32(self._gidx(p_num)),
+                jnp.int32(self._gidx(q_num)), jnp.asarray(z0),
+                jnp.full(C, maxiter, dtype=jnp.int32),
+                jnp.asarray(conv_mask), self.models, self.block_part,
+                self.weights, self.tips, self.site_rates)
         self._set_buf(buf)
         return np.asarray(z, dtype=np.float64)
 
@@ -1154,14 +1285,18 @@ class LikelihoodEngine:
         `evaluatePartialGeneric` scan (SURVEY §7.3(5)).
         """
         assert self.psr
+        obs.inc("engine.dispatch_count")
+        obs.inc("engine.traversal_entries", len(entries))
         tv = self._traversal_arrays(entries)
         zv = jnp.asarray(_z_slots(z, self.num_branch_slots), dtype=self.dtype)
         grid_dev = self._put_blocks(
             np.asarray(grid, dtype=self.dtype), lambda s: s.sites)
-        out = self._jit_rate_scan(
-            self.tips, tv, jnp.int32(self._gidx(p_num)),
-            jnp.int32(self._gidx(q_num)), zv, grid_dev, self.models,
-            self.block_part)
+        with obs.device_span("engine:rate_scan",
+                             args={"grid": int(grid.shape[-1])}):
+            out = self._jit_rate_scan(
+                self.tips, tv, jnp.int32(self._gidx(p_num)),
+                jnp.int32(self._gidx(q_num)), zv, grid_dev, self.models,
+                self.block_part)
         if self.sharding is not None and jax.process_count() > 1:
             # Multi-host: the per-site scan result is block-sharded
             # across processes; the host-side PSR crawl/categorization
@@ -1189,16 +1324,21 @@ class LikelihoodEngine:
                                       axis_name=self._axis_name)
 
     def make_sumtable(self, p_num: int, q_num: int) -> jax.Array:
+        obs.inc("engine.dispatch_count")
         buf, aux = self._state()
-        return self._jit_sumtable(buf, self.scaler, aux,
-                                  jnp.int32(self._gidx(p_num)),
-                                  jnp.int32(self._gidx(q_num)), self.models,
-                                  self.block_part, self.tips)
+        with obs.device_span("engine:sumtable"):
+            return self._jit_sumtable(buf, self.scaler, aux,
+                                      jnp.int32(self._gidx(p_num)),
+                                      jnp.int32(self._gidx(q_num)),
+                                      self.models, self.block_part,
+                                      self.tips)
 
     def branch_derivatives(self, st: jax.Array, z: Sequence[float]):
+        obs.inc("engine.dispatch_count")
         zv = jnp.asarray(_z_slots(z, self.num_branch_slots), dtype=self.dtype)
-        d1, d2 = self._jit_derivs(st, zv, self.models, self.block_part,
-                                  self.weights, self.site_rates)
+        with obs.device_span("engine:derivs"):
+            d1, d2 = self._jit_derivs(st, zv, self.models, self.block_part,
+                                      self.weights, self.site_rates)
         return np.asarray(d1), np.asarray(d2)
 
 

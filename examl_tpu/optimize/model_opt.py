@@ -16,6 +16,7 @@ from typing import Callable, List, Sequence
 
 import numpy as np
 
+from examl_tpu import obs
 from examl_tpu.constants import ALPHA_MAX, ALPHA_MIN, RATE_MAX, RATE_MIN
 from examl_tpu.instance import PhyloInstance
 from examl_tpu.models.gtr import (ModelParams, n_exchange, with_alpha,
@@ -249,39 +250,44 @@ def mod_opt(inst: PhyloInstance, tree: Tree, likelihood_epsilon: float,
         if os.environ.get("EXAML_DEBUG_MODOPT"):
             print(f"modopt {tag}: {inst.likelihood:.6f}", flush=True)
 
+    rounds = 0
     while max_rounds > 0:
         max_rounds -= 1
         current = inst.likelihood
-        dbg("start")
-        opt_rates(inst, tree)
-        dbg("after rates")
-        if auto_protein_fn is not None:
-            auto_protein_fn(inst, tree)
-        tree_evaluate(inst, tree, 0.0625)
-        dbg("after br-len 1")
-        opt_freqs(inst, tree)
-        tree_evaluate(inst, tree, 0.0625)
-        dbg("after freqs")
-        if getattr(inst, "psr", False):
-            if inst.cat_opt_rounds < 3:
-                from examl_tpu.optimize.psr import optimize_rate_categories
-                optimize_rate_categories(inst, tree)
-                inst.cat_opt_rounds += 1
-                dbg("after cat-opt")
+        rounds += 1
+        obs.inc("search.model_opt_rounds")
+        with obs.span("opt:model_opt_round", args={"round": rounds}):
+            dbg("start")
+            opt_rates(inst, tree)
+            dbg("after rates")
+            if auto_protein_fn is not None:
+                auto_protein_fn(inst, tree)
+            tree_evaluate(inst, tree, 0.0625)
+            dbg("after br-len 1")
+            opt_freqs(inst, tree)
+            tree_evaluate(inst, tree, 0.0625)
+            dbg("after freqs")
+            if getattr(inst, "psr", False):
+                if inst.cat_opt_rounds < 3:
+                    from examl_tpu.optimize.psr import (
+                        optimize_rate_categories)
+                    optimize_rate_categories(inst, tree)
+                    inst.cat_opt_rounds += 1
+                    dbg("after cat-opt")
+                else:
+                    # Rounds beyond the reference's 3: its CAT branch does
+                    # nothing more for rate heterogeneity; we polish the
+                    # frozen categorization's representative rates as free
+                    # continuous parameters (accept-if-better; the PSR
+                    # analogue of the GAMMA branch's alpha Brent).
+                    from examl_tpu.optimize.psr import refine_category_rates
+                    refine_category_rates(inst, tree)
+                    dbg("after cat-refine")
             else:
-                # Rounds beyond the reference's 3: its CAT branch does
-                # nothing more for rate heterogeneity; we polish the
-                # frozen categorization's representative rates as free
-                # continuous parameters (accept-if-better; the PSR
-                # analogue of the GAMMA branch's alpha Brent).
-                from examl_tpu.optimize.psr import refine_category_rates
-                refine_category_rates(inst, tree)
-                dbg("after cat-refine")
-        else:
-            opt_alphas(inst, tree)
-            opt_lg4x(inst, tree)
-            tree_evaluate(inst, tree, 0.1)
-            dbg("after alphas + br-len 2")
+                opt_alphas(inst, tree)
+                opt_lg4x(inst, tree)
+                tree_evaluate(inst, tree, 0.1)
+                dbg("after alphas + br-len 2")
         if checkpoint_cb is not None:
             checkpoint_cb("MOD_OPT", {})
         if abs(current - inst.likelihood) <= likelihood_epsilon:

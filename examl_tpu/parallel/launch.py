@@ -65,6 +65,27 @@ def init_distributed(args, log=lambda msg: None) -> None:
         f"{jax.device_count()} global devices")
 
 
+def enable_process_tracing(trace_dir: str,
+                           log=lambda msg: None) -> Optional[str]:
+    """Open this process's span-trace file under `trace_dir`, named by
+    process index (`trace.p<procid>.jsonl`) so a multi-host job's
+    processes never share a writer; process 0 merges a cross-process
+    `summary.json` when it exits (obs.trace.finalize).  Call AFTER
+    init_distributed so the procid is the job's, not a guess."""
+    from examl_tpu import obs
+
+    try:
+        # procid=None delegates to the canonical resolver
+        # (obs.trace._default_procid): EXAML_PROCID override first, then
+        # jax.process_index() when a distributed client exists, else 0.
+        path = obs.enable_tracing(trace_dir)
+    except OSError as exc:
+        log(f"trace events disabled ({exc})")
+        return None
+    log(f"trace events -> {path}")
+    return path
+
+
 def select_sharding(args, save_memory: bool,
                     log=lambda msg: None) -> Optional[SiteSharding]:
     """A site-axis sharding over every visible device, or None for the

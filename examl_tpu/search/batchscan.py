@@ -40,6 +40,7 @@ from typing import List, NamedTuple, Optional, Tuple
 
 import numpy as np
 
+from examl_tpu import obs
 from examl_tpu.constants import DEFAULTZ, DELTAZ, ZMAX, ZMIN
 from examl_tpu.tree.topology import Node, Tree
 
@@ -200,9 +201,12 @@ def run_plan(inst, tree: Tree, plan: ScanPlan) -> np.ndarray:
     ONE device program per engine — one dispatch per pruned node.
     """
     N = len(plan.candidates)
+    obs.inc("search.scan_dispatches")
+    obs.inc("search.scan_candidates", N)
     total = np.zeros(N, dtype=np.float64)
-    for eng in inst.engines.values():
-        total += np.asarray(eng.batched_scan(plan), dtype=np.float64)
+    with obs.span("search:spr_batched_scan", args={"candidates": N}):
+        for eng in inst.engines.values():
+            total += np.asarray(eng.batched_scan(plan), dtype=np.float64)
     return total
 
 
@@ -481,5 +485,9 @@ def run_plan_thorough(inst, tree: Tree, plan: ScanPlan
     Single-engine, single-branch-slot instances only (the caller
     gates); the padding/chunk/dispatch plumbing lives on the engine
     next to the lazy arm's (`LikelihoodEngine.batched_thorough`)."""
+    obs.inc("search.scan_dispatches")
+    obs.inc("search.scan_candidates", len(plan.candidates))
     (eng,) = inst.engines.values()
-    return eng.batched_thorough(plan)
+    with obs.span("search:spr_batched_thorough",
+                  args={"candidates": len(plan.candidates)}):
+        return eng.batched_thorough(plan)

@@ -13,6 +13,7 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Callable, List, Optional
 
+from examl_tpu import obs
 from examl_tpu.constants import UNLIKELY
 from examl_tpu.instance import PhyloInstance
 from examl_tpu.optimize.branch import tree_evaluate
@@ -59,6 +60,18 @@ def tree_optimize_rapid(inst: PhyloInstance, tree: Tree, ctx: SprContext,
                         bt: BestList, best_ml: Optional[BestList],
                         ilist: InfoList) -> float:
     """One SPR cycle over all nodes (reference `treeOptimizeRapid`)."""
+    obs.inc("search.spr_cycles")
+    with obs.span("search:spr_cycle",
+                  args={"mintrav": mintrav, "maxtrav": maxtrav,
+                        "thorough": bool(ctx.thorough)}):
+        return _tree_optimize_rapid(inst, tree, ctx, mintrav, maxtrav, bt,
+                                    best_ml, ilist)
+
+
+def _tree_optimize_rapid(inst: PhyloInstance, tree: Tree, ctx: SprContext,
+                         mintrav: int, maxtrav: int,
+                         bt: BestList, best_ml: Optional[BestList],
+                         ilist: InfoList) -> float:
     slots = dfs_slot_order(tree)
     maxtrav = min(maxtrav, tree.ntips - 3)
     ilist.reset()
@@ -127,6 +140,13 @@ def determine_rearrangement_setting(inst: PhyloInstance, tree: Tree,
     """Scan radii 5,10,...,25 on the starting tree; return the smallest
     radius attaining the best lnL (reference
     `determineRearrangementSetting`)."""
+    with obs.span("search:radius_autotune"):
+        return _determine_rearrangement_setting(
+            inst, tree, ctx, opts, best_t, bt, best_ml, checkpoint_cb)
+
+
+def _determine_rearrangement_setting(inst, tree, ctx, opts, best_t, bt,
+                                     best_ml, checkpoint_cb=None) -> int:
     maxtrav, best_trav = 5, 5
     start_lh = inst.likelihood
     impr = True
@@ -294,14 +314,16 @@ def compute_big_rapid(inst: PhyloInstance, tree: Tree,
                     break
 
             fast_iterations += 1
+            obs.inc("search.fast_cycles")
             tree_evaluate(inst, tree, 1.0)
             best_t.save(tree, inst.likelihood)
             opts.log(f"fast cycle {fast_iterations} start "
                      f"lnL {inst.likelihood:.6f}")
             lh = previous_lh = inst.likelihood
 
-            tree_optimize_rapid(inst, tree, ctx, 1, best_trav, bt, best_ml,
-                                ilist)
+            # (per-cycle span emitted inside tree_optimize_rapid)
+            tree_optimize_rapid(inst, tree, ctx, 1, best_trav, bt,
+                                best_ml, ilist)
 
             impr = False
             for i in range(1, bt.nvalid + 1):
@@ -344,6 +366,7 @@ def compute_big_rapid(inst: PhyloInstance, tree: Tree,
                     res.converged_by_rf = True
                     break
             thorough_iterations += 1
+            obs.inc("search.thorough_cycles")
         else:
             rearr_max += opts.stepwidth
             rearr_min += opts.stepwidth
@@ -356,6 +379,7 @@ def compute_big_rapid(inst: PhyloInstance, tree: Tree,
         opts.log(f"thorough cycle {thorough_iterations} radius "
                  f"{rearr_min}-{rearr_max} lnL {inst.likelihood:.6f}")
 
+        # (per-cycle span emitted inside tree_optimize_rapid)
         tree_optimize_rapid(inst, tree, ctx, rearr_min, rearr_max, bt,
                             best_ml, ilist)
 

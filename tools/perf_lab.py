@@ -9,7 +9,6 @@ structural variant, so changes can be evaluated one at a time.
 from __future__ import annotations
 
 import functools
-import time
 
 import jax
 import jax.numpy as jnp
@@ -32,12 +31,12 @@ N_STEPS = 50
 
 
 def timed(fn, *args):
-    out = fn(*args)
-    jax.block_until_ready(out)
-    t0 = time.perf_counter()
-    out = fn(*args)
-    jax.block_until_ready(out)
-    return time.perf_counter() - t0
+    """One warm (compile) call, then one timed call — through the obs
+    dispatch-timer API, so the lab and bench.py share one definition of
+    "dispatch time" (and every measurement lands in the registry)."""
+    from examl_tpu import obs
+    return obs.time_dispatch(lambda: jax.block_until_ready(fn(*args)),
+                             reps=1, warmup=1, name="perf_lab.dispatch")
 
 
 def report(name, dt, entries, patterns, rates, states, n_steps=N_STEPS):
