@@ -20,8 +20,14 @@ the remaining plan if the chip still answers a probe.
 Stages: `s-scan` / `s-chunks` / `s-pallas` / `s-whole` time the four
 traversal tiers on testData/140 (scan first — the one tier whose
 compile is hardware-proven since r02, so the primary metric always
-lands); `L:<config>` are the compute-bound large configs (ROOFLINE.md);
-`prims` times the fused search primitives.
+lands); `L:<config>` are the compute-bound large configs (ROOFLINE.md)
+plus CPU-runnable `*-mid` rows for every BASELINE config (AA, PSR, SEV,
+bf16) so fallback rounds still carry per-config evidence; `prims` times
+the fused search primitives.  Workers dispatch only BANKED programs:
+families the per-host bank manifest (ops/bank.py, `--bank`) recorded as
+wedged are skipped with a note instead of re-raced, and a worker death
+is recorded with its exit signal/returncode so SIGILL, OOM, and
+hang-kill are distinguishable in the artifact.
 
 vs_baseline compares against one AVX socket of the reference build and
 is only marked valid for accelerator runs (round-3 lesson: a CPU
@@ -79,9 +85,15 @@ FALLBACK_AVX_UPDATES_PER_SEC = 2.0e9
 TPU_PLAN = ["s-scan", "L:dna-large", "L:aa-large", "L:dna-bf16",
             "L:dna-psr", "L:dna-sev", "pallas-check", "s-chunks",
             "s-pallas", "s-whole", "prims"]
-# The CPU fallback also records one (small) large-config row so every
-# BENCH artifact carries compute-bound evidence tagged with its backend.
-CPU_PLAN = ["s-scan", "L:dna-mid", "s-chunks", "prims"]
+# The CPU fallback records a (small) large-config row for EVERY
+# BASELINE config — DNA, protein, PSR, SEV, bf16 — so each round's
+# artifact carries a backend-tagged number per config even when the
+# chip never answers (VERDICT r05 Next §3: after three fallback rounds
+# no artifact anywhere had a protein/PSR/SEV/bf16 row on any backend).
+# Mid configs come right after the proven scan stage and before the
+# chunk/prims stages so a budget squeeze drops tiers, not configs.
+CPU_PLAN = ["s-scan", "L:dna-mid", "L:aa-mid", "L:psr-mid", "L:sev-mid",
+            "L:bf16-mid", "s-chunks", "prims"]
 
 LARGE_CONFIGS = {
     # name: (ntaxa, patterns, datatype, mode) — sized to keep the f32
@@ -99,6 +111,13 @@ LARGE_CONFIGS = {
     "dna-bf16": (140, 524_288, "DNA", "bf16"),
     # CPU-fallback-sized: compute-bound on a host core, ~1.2 GB f64.
     "dna-mid": (140, 32_768, "DNA", ""),
+    # Mid-size companions of BASELINE configs 2-5, CPU-runnable so every
+    # round's artifact has a row per config (widths follow the manual's
+    # per-core pattern guidance: ~1k AA, 12-16k PSR patterns/core).
+    "aa-mid": (140, 8_192, "AA", ""),
+    "psr-mid": (140, 16_384, "DNA", "psr"),
+    "sev-mid": (140, 16_384, "DNA", "sev"),
+    "bf16-mid": (140, 32_768, "DNA", "bf16"),
 }
 
 
@@ -555,6 +574,29 @@ def _stage_prims(state: _WorkerState) -> dict:
     return out
 
 
+# Program families each bench stage dispatches (ops/bank.py labels):
+# a family the bank recorded as wedged/broken on THIS host must not be
+# dispatched by a bench worker either — the stage is skipped with a
+# note instead of re-racing a known wedge (wedge-immune dispatch).
+# The scan tier and the fused prims have no entry: they are the
+# fallback programs every degradation lands on.
+_STAGE_FAMILIES = {"s-chunks": ("fast",), "s-pallas": ("fast",),
+                   "s-whole": ("whole",), "pallas-check": ("fast",
+                                                           "whole")}
+
+
+def _bank_degraded_families() -> set:
+    """Families the per-host bank manifest marks timeout/error (empty
+    when no bank has run here, or EXAML_BENCH_IGNORE_BANK=1)."""
+    if os.environ.get("EXAML_BENCH_IGNORE_BANK") == "1":
+        return set()
+    try:
+        from examl_tpu.ops import bank
+        return bank.manifest_degraded_families(bank.load_manifest())
+    except Exception:                            # noqa: BLE001
+        return set()
+
+
 def _worker(plan, best_hint: str) -> None:
     import jax
     jax.config.update("jax_enable_x64", True)
@@ -568,6 +610,7 @@ def _worker(plan, best_hint: str) -> None:
             sys.stderr.write(f"bench: compile cache at {path}\n")
     except Exception as exc:                     # noqa: BLE001
         sys.stderr.write(f"bench: compile cache unavailable: {exc}\n")
+    degraded = _bank_degraded_families()
 
     state = _WorkerState()
     # best_hint is "variant" or "variant:ups" (a resumed worker must not
@@ -591,6 +634,14 @@ def _worker(plan, best_hint: str) -> None:
             # tiers must not be timed at all — a fast-but-wrong kernel
             # would win the headline metric and steer the large configs.
             print(f"##skip {sid} pallas-check-failed", flush=True)
+            continue
+        bad = [f for f in _STAGE_FAMILIES.get(sid, ()) if f in degraded]
+        if bad:
+            # The bank already proved these programs wedge/break on this
+            # host; dispatch only banked programs (EXAML_BENCH_IGNORE_BANK
+            # =1 overrides for deliberate re-tests).
+            print(f"##skip {sid} bank-degraded:{','.join(bad)}",
+                  flush=True)
             continue
         print(f"##start {sid}", flush=True)
         try:
@@ -670,6 +721,24 @@ def _child_env(cpu: bool) -> dict:
           if p and not any(c in p.split(os.sep) for c in strip if c)]
     env["PYTHONPATH"] = os.pathsep.join(pp) if pp else ""
     return env
+
+
+def _exit_desc(rc) -> str:
+    """Human-readable worker exit cause (duplicated from ops/bank.py on
+    purpose: the bench PARENT must not import examl_tpu/jax — a broken
+    accelerator plugin can hang the importing process, which is why the
+    backend probe runs in a subprocess).  Negative returncodes name
+    their signal so "worker exited" distinguishes a SIGILL (mis-featured
+    cached kernel, the r05 killer) from an OOM kill from a hang-kill."""
+    if rc is None:
+        return "(hang-killed)"
+    if rc < 0:
+        import signal
+        try:
+            return f"(signal {signal.Signals(-rc).name})"
+        except ValueError:
+            return f"(signal {-rc})"
+    return f"(returncode {rc})"
 
 
 def _merge_metrics(results: dict, snapshot: dict) -> None:
@@ -769,18 +838,33 @@ def _orchestrate(cpu: bool, plan, results: dict, notes: list) -> None:
             best = f"{name_}:{ups_:.1f}"
         plan = [s for s in plan if s not in results]
         if not timed_out:
-            for sid in plan:
-                notes.append(f"stage {sid} not run (worker exited)")
-            return
-        if hung:
+            rc = proc.returncode
+            desc = _exit_desc(rc)
+            if rc != 0 and hung:
+                # The worker DIED inside a specific stage (r05 lesson:
+                # "worker exited" hid what were plausibly SIGILLs from
+                # mis-featured cached kernels).  That stage is the
+                # casualty — record its signal/returncode — and a fresh
+                # worker resumes the remaining plan.
+                results[hung] = {"error": f"worker died mid-stage {desc}"}
+                notes.append(f"stage {hung} died {desc}")
+                plan = [s for s in plan if s != hung]
+            else:
+                for sid in plan:
+                    notes.append(
+                        f"stage {sid} not run (worker exited {desc})")
+                return
+        elif hung:
             results[hung] = {"error": "stage deadline exceeded (killed)"}
-            notes.append(f"stage {hung} hung; killed worker")
+            notes.append(f"stage {hung} hung; killed worker "
+                         + _exit_desc(None))
             plan = [s for s in plan if s != hung]
         elif len([k for k in results if k != "__metrics__"]) == n_before:
             # Worker wedged before its first ##start marker (backend
             # init): retrying the identical plan would burn the budget
             # attempt by attempt.
-            notes.append("worker wedged before any stage; abandoning: "
+            notes.append("worker wedged before any stage "
+                         + _exit_desc(None) + "; abandoning: "
                          + ",".join(plan))
             return
         if not cpu and plan:

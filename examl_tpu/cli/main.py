@@ -65,6 +65,20 @@ def build_argparser() -> argparse.ArgumentParser:
                     help="memory saving for gappy alignments")
     ap.add_argument("-w", dest="workdir", default=".",
                     help="output directory")
+    ap.add_argument("--bank", dest="bank", action="store_true",
+                    help="ahead-of-time program banking: compile every "
+                         "device-program family this run will dispatch "
+                         "in parallel killable subprocess workers at "
+                         "startup (persistent host-fingerprinted cache); "
+                         "a family whose compile exceeds "
+                         "--compile-timeout is killed and the run "
+                         "degrades to the scan tier instead of wedging")
+    ap.add_argument("--compile-timeout", dest="compile_timeout",
+                    type=float, default=180.0,
+                    help="per-family compile deadline in seconds: hard "
+                         "(kill + scan-tier fallback) for --bank "
+                         "workers, watchdog-bark threshold for any "
+                         "in-process compile (default 180)")
     ap.add_argument("--profile", dest="profile_dir", default=None,
                     help="write a jax profiler trace to this directory "
                          "(SURVEY §5.1; view with xprof/tensorboard)")
@@ -212,7 +226,11 @@ def selective_read_decision(model: str, is_bytefile: bool,
     loading policy, pure so it is unit-testable without a process group:
 
     * "slice": each process seeks only its site blocks (readMyData,
-      `byteFile.c:278-382`);
+      `byteFile.c:278-382`) — including -m PSR, whose per-site rate
+      state stays host-global via allgathers (engine.rate_scan output;
+      one weight-window gather, instance.psr_packed_weights — the
+      reference's CAT Gatherv/Scatterv, `optimizeModel.c:2135-2254`,
+      as collectives);
     * "whole": every process reads the full file (single-process jobs;
       AUTO-protein partitions, whose BIC/AICc sample sizes must be
       global; non-byteFile inputs);
@@ -221,19 +239,17 @@ def selective_read_decision(model: str, is_bytefile: bool,
     """
     if nprocs <= 1:
         return "whole", "single process"
-    if model == "PSR":
-        return "whole", ("-m PSR multi-process: per-site scan results "
-                         "allgather to every process (the reference's "
-                         "CAT Gatherv/Scatterv, optimizeModel.c:2135-"
-                         "2254); whole-file read per process")
     if not is_bytefile:
         return "whole", "input is not a byteFile"
     if has_auto_aa:
         return "whole", ("AUTO protein model selection needs global "
                          "sample sizes")
-    return "slice", ("selective byteFile read"
-                     + (" (-S gap bookkeeping follows the window)"
-                        if save_memory else ""))
+    note = ""
+    if model == "PSR":
+        note = " (-m PSR rate state allgathers to every process)"
+    if save_memory:
+        note += " (-S gap bookkeeping follows the window)"
+    return "slice", "selective byteFile read" + note
 
 
 def _is_bytefile(path: str) -> bool:
@@ -507,8 +523,15 @@ def main(argv=None) -> int:
                                            init_distributed)
 
     # One run = one metrics record: callers invoking main() repeatedly in
-    # a single process (tests) must not accumulate counters across runs.
+    # a single process (tests) must not accumulate counters across runs
+    # (nor inherit a previous run's bank verdicts).
     obs.reset()
+    from examl_tpu.ops import bank as _bank
+    _bank.reset()
+    # One deadline definition for every compile monitor: the bank
+    # workers' hard per-family kill AND the in-process watchdog bark
+    # read the same knob (exported so subprocess workers inherit it).
+    os.environ["EXAML_COMPILE_TIMEOUT"] = repr(float(args.compile_timeout))
     # Join the multi-host job BEFORE any output: only process 0 writes
     # run files (the reference's processID==0 gating); other processes
     # compute the same SPMD program with their files diverted to a
@@ -563,6 +586,22 @@ def _run(args, files: RunFiles) -> int:
     files.info(f"alignment: {args.bytefile}  mode: -f {args.mode}  "
                f"model: {args.model}")
 
+    bank_report = None
+    if getattr(args, "bank", False):
+        # Ahead-of-time program banking, BEFORE this process touches
+        # its backend: killable subprocess workers populate the
+        # persistent cache (and must be able to own an
+        # exclusive-access accelerator, then release it to us), wedged
+        # families get their scan-tier escape hatches pinned, and —
+        # multi-host — every process banks before the collective
+        # barrier so no peer enters the SPMD program while another is
+        # still compiling.
+        from examl_tpu.ops import bank
+        from examl_tpu.parallel.launch import bank_barrier
+        with files.phase("bank (aot compile)"):
+            bank_report = bank.run_bank(args, log=files.info)
+            bank_barrier(args, log=files.info)
+
     with files.phase("startup (io + engines)"):
         from examl_tpu.config import enable_persistent_compilation_cache
         cache = enable_persistent_compilation_cache()
@@ -612,6 +651,25 @@ def _run(args, files: RunFiles) -> int:
             local_window=local_window)
         inst.auto_prot_criterion = args.auto_prot
         _packing_report(inst, files)
+
+    if bank_report is not None:
+        # First-call every banked family NOW, as persistent-cache hits:
+        # the engine's compile monitors fire inside this phase (counted
+        # as engine.compile_count.bank_phase), so the search performs
+        # zero first-call compiles — any later shape-variant compile is
+        # a cache-warm member of a banked family.
+        from examl_tpu.ops import bank
+        with files.phase("bank (warm programs)"):
+            try:
+                warm_tree = (inst.tree_from_newick(
+                    _read_trees(args.tree_file)[0])
+                    if args.tree_file else inst.random_tree(args.seed))
+                bank.warm_instance(inst, warm_tree, bank_report,
+                                   files.info)
+            except Exception as exc:       # noqa: BLE001 — warm is an
+                # optimization; its failure must not kill the run
+                files.info(f"bank warm pass failed ({exc}); programs "
+                           "compile lazily (watchdogged)")
 
     with contextlib.ExitStack() as stack:
         if args.profile_dir:

@@ -29,9 +29,54 @@ def enable_x64() -> None:
     jax.config.update("jax_enable_x64", True)
 
 
+def host_feature_fingerprint() -> str | None:
+    """Short hex fingerprint of THIS host's CPU feature set, or None when
+    it cannot be determined.
+
+    Round-5 postmortem (VERDICT Weak §2): the persistent CPU compile
+    cache was keyed by `platform + platform_version` only — identical
+    across CPU hosts with different microarchitectures — and served
+    executables compiled for another host's CPU features (XLA's own
+    tail warning: "could lead to execution errors such as SIGILL"; the
+    r05 bench workers that died with "worker exited" are the plausible
+    victims).  The fingerprint hashes the ISA-feature inventory
+    (/proc/cpuinfo `flags`/`Features` plus the model name) so hosts
+    with different vector extensions get disjoint cache partitions.
+
+    EXAML_HOST_FINGERPRINT overrides (deployments that know better,
+    tests); an empty override means "unknown" (persistence then turns
+    off for CPU caches — see enable_persistent_compilation_cache).
+    """
+    import hashlib
+    import os
+
+    env = os.environ.get("EXAML_HOST_FINGERPRINT")
+    if env is not None:
+        return env or None
+    try:
+        feats = []
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                key, _, val = line.partition(":")
+                # x86 spells the ISA inventory "flags", arm64 "Features";
+                # "model name" catches microarch differences the flag
+                # list alone may not (one physical package is enough —
+                # cores are homogeneous per /proc/cpuinfo contract).
+                if key.strip() in ("flags", "Features", "model name"):
+                    feats.append(val.strip())
+                    if len(feats) >= 2:
+                        break
+        if not feats:
+            return None
+        return hashlib.sha1("|".join(sorted(feats)).encode()).hexdigest()[:12]
+    except OSError:
+        return None
+
+
 def enable_persistent_compilation_cache(cache_dir: str | None = None):
     """Turn on JAX's on-disk compilation cache, partitioned per backend
-    build string.
+    build string AND — for CPU backends — per host CPU-feature
+    fingerprint.
 
     The reference pays its "compile" cost once at make time
     (`Makefile.AVX.gcc`); this framework pays it per process at trace
@@ -39,12 +84,17 @@ def enable_persistent_compilation_cache(cache_dir: str | None = None):
     compile can block for minutes and a killed client wedges the
     service.  A persistent cache makes compiles durable across process
     kills and wedge windows, so a brief healthy window suffices to
-    bank every program.
+    bank every program (ops/bank.py compiles into this cache from
+    killable subprocess workers at CLI startup).
 
     The cache subdirectory embeds platform + platform_version (the
     libtpu build string): after a backend upgrade the old entries
-    become unreachable rather than a version-mismatch hazard.  Set
-    EXAML_COMPILE_CACHE=0 to disable, or to a path to relocate.
+    become unreachable rather than a version-mismatch hazard.  CPU
+    caches additionally embed `host_feature_fingerprint()`; when no
+    fingerprint is available the CPU cache is DISABLED rather than
+    risk serving another microarchitecture's executables (SIGILL —
+    the round-5 bench killer).  Set EXAML_COMPILE_CACHE=0 to disable,
+    or to a path to relocate.
 
     Returns the cache path, or None when disabled/unavailable.
     """
@@ -60,6 +110,11 @@ def enable_persistent_compilation_cache(cache_dir: str | None = None):
         dev = jax.devices()[0]      # forces backend init; may raise
         key = "%s-%s" % (dev.platform,
                          getattr(dev.client, "platform_version", "?"))
+        if dev.platform == "cpu":
+            fp = host_feature_fingerprint()
+            if fp is None:
+                return None
+            key += "-" + fp
         sub = re.sub(r"[^A-Za-z0-9._-]+", "_", key)[:60]
         path = os.path.join(
             root, f"{sub}-{hashlib.sha1(key.encode()).hexdigest()[:10]}")
@@ -76,4 +131,14 @@ def enable_persistent_compilation_cache(cache_dir: str | None = None):
         # No usable backend, or the cache root is unwritable (HOME
         # unset / read-only / quota): run without a cache — a missing
         # optimization must never abort startup or test collection.
+        return None
+
+
+def persistent_cache_dir() -> str | None:
+    """The currently-configured persistent cache dir, or None.  The
+    program-bank manifest (ops/bank.py) lives next to the cache entries
+    so its banked/degraded verdicts share the cache's host scoping."""
+    try:
+        return jax.config.jax_compilation_cache_dir
+    except AttributeError:
         return None

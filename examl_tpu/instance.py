@@ -22,6 +22,20 @@ from examl_tpu.parallel.packing import pack_partitions
 from examl_tpu.tree.topology import Node, Tree, TraversalEntry
 
 
+def packed_site_rates(bucket, per_site_rates, rate_category) -> np.ndarray:
+    """GLOBAL packed per-site rate multipliers [B, lane] for a bucket
+    (padding sites keep rate 1): `perSiteRates[rateCategory]` scattered
+    through the bucket's global layout.  Pure layout arithmetic — the
+    same on every process of a selective-loading job because the rate
+    state is host-global (each engine then materializes only its block
+    window, engine._local_block_window)."""
+    packed = np.ones(bucket.num_sites)
+    for li, gid in enumerate(bucket.part_ids):
+        packed[bucket.site_indices(li)] = \
+            per_site_rates[gid][rate_category[gid]]
+    return packed.reshape(bucket.num_blocks, bucket.lane)
+
+
 class PhyloInstance:
     def __init__(self, alignment: AlignmentData, dtype=None,
                  ncat: int = 4, use_median: bool = False,
@@ -91,9 +105,6 @@ class PhyloInstance:
             # packed axis (reference per-rank loading, byteFile.c:278-382).
             from examl_tpu.parallel.packing import pack_partitions_local
             procid, nprocs = local_window
-            if self.psr:
-                raise ValueError("per-process selective loading does not "
-                                 "support PSR yet")
             self.buckets = pack_partitions_local(
                 alignment.partitions, procid, nprocs,
                 block_multiple=block_multiple)
@@ -112,15 +123,24 @@ class PhyloInstance:
                 sharding=sharding, psr=self.psr, save_memory=save_memory)
 
         # PSR per-site rate state (reference patrat / rateCategory /
-        # perSiteRates, `axml.h:585-600`): host copies per partition.
+        # perSiteRates, `axml.h:585-600`): host copies per partition,
+        # sized GLOBAL even under selective loading — the rate scan
+        # allgathers per-site lnls to every process and the
+        # categorization then runs identically everywhere (the
+        # reference's Gatherv/Scatterv CAT pipeline,
+        # `optimizeModel.c:2135-2254`, as one collective).
         if self.psr:
-            self.patrat = [np.ones(p.width) for p in alignment.partitions]
-            self.site_lhs = [np.zeros(p.width) for p in alignment.partitions]
-            self.rate_category = [np.zeros(p.width, dtype=np.int32)
-                                  for p in alignment.partitions]
+            widths = [p.global_width if p.global_width is not None
+                      else p.width for p in alignment.partitions]
+            self.patrat = [np.ones(w) for w in widths]
+            self.site_lhs = [np.zeros(w) for w in widths]
+            self.rate_category = [np.zeros(w, dtype=np.int32)
+                                  for w in widths]
             self.per_site_rates = [np.ones(1) for _ in alignment.partitions]
             self.psr_invocations = 0
             self.cat_opt_rounds = 0
+            self._psr_global_weights: Optional[Dict[int, np.ndarray]] = None
+            self._psr_packed_weights: Dict[int, np.ndarray] = {}
 
         self.per_partition_lnl = np.full(M, np.nan)
         self.likelihood = np.nan
@@ -152,12 +172,54 @@ class PhyloInstance:
         distinction between patrat and perSiteRates, `axml.h:585-600`)."""
         assert self.psr
         for states, bucket in self.buckets.items():
-            packed = np.ones(bucket.num_sites)
-            for li, gid in enumerate(bucket.part_ids):
-                packed[bucket.site_indices(li)] = \
-                    self.per_site_rates[gid][self.rate_category[gid]]
-            self.engines[states].set_site_rates(
-                packed.reshape(bucket.num_blocks, bucket.lane))
+            self.engines[states].set_site_rates(packed_site_rates(
+                bucket, self.per_site_rates, self.rate_category))
+
+    # -- PSR global per-site state under selective loading ------------------
+    # The scan/categorize pipeline is host-GLOBAL on every process (the
+    # per-site lnls allgather in engine.rate_scan; the categorization is
+    # deterministic), but under selective loading each process's bucket
+    # holds only its window of the packed weights.  One host allgather
+    # of the weight windows (contiguous, procid-ordered — they tile the
+    # axis) recovers the global view every process needs for the
+    # weighted crawl and the weighted-mean-rate-1 normalization — the
+    # per-site-rate-state allgather replacing the reference's
+    # Gatherv/Scatterv legs (`optimizeModel.c:2135-2254`).
+
+    def psr_packed_weights(self, bucket) -> np.ndarray:
+        """GLOBAL packed pattern weights [B, lane] for a bucket.
+        Weights are static, so the cross-process gather runs ONCE per
+        bucket and is cached — every PSR scan/normalize round reuses
+        it rather than re-collecting on the search path."""
+        cached = self._psr_packed_weights.get(bucket.states)
+        if cached is not None:
+            return cached
+        w = np.asarray(bucket.weights, dtype=np.float64).reshape(
+            bucket.local_num_blocks, bucket.lane)
+        if bucket.is_local:
+            import jax
+            if jax.process_count() > 1:
+                from jax.experimental import multihost_utils
+                w = np.asarray(
+                    multihost_utils.process_allgather(w, tiled=True))
+            # else: a 1-process window IS global — keep w as is
+        self._psr_packed_weights[bucket.states] = w
+        return w
+
+    def psr_pattern_weights(self, gid: int) -> np.ndarray:
+        """GLOBAL pattern weights of partition `gid` (== the partition's
+        own weights on a full read)."""
+        part = self.alignment.partitions[gid]
+        if getattr(part, "global_width", None) is None:
+            return np.asarray(part.weights, dtype=np.float64)
+        if self._psr_global_weights is None:
+            self._psr_global_weights = {}
+            for states, bucket in self.buckets.items():
+                flat = self.psr_packed_weights(bucket).reshape(-1)
+                for li, g in enumerate(bucket.part_ids):
+                    self._psr_global_weights[g] = flat[
+                        bucket.site_indices(li)].copy()
+        return self._psr_global_weights[gid]
 
     # -- tree construction -------------------------------------------------
 

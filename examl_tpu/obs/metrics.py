@@ -13,8 +13,13 @@ Naming convention (dotted, lowercase):
   engine.traversal_entries     newview entries submitted (retraversal size)
   engine.cache_hits/misses/evictions   shared fast-program LRU
   engine.compile_count, engine.compile_seconds[.family]
+  engine.compile_count.bank_phase      first calls inside the bank phase
+  engine.first_calls.banked/unbanked   post-bank first calls by verdict
   engine.pallas_fallbacks      Mosaic -> XLA demotions
-  engine.watchdog_barks        >180 s compile watchdog firings
+  engine.watchdog_barks        compile-deadline watchdog firings
+  bank.families/banked/timeouts/errors/skipped/fallbacks   AOT banking
+  bank.compile.<family>        per-family subprocess compile (timers)
+  bank.engine.*                worker-side compile counters, merged
   search.spr_cycles, search.fast_cycles, search.thorough_cycles
   search.scan_dispatches, search.scan_candidates
   phase.<name>                 CLI wall-clock phases (timers)

@@ -184,10 +184,6 @@ class LikelihoodEngine:
             if sharding is None:
                 raise ValueError("a local (sliced) bucket requires a "
                                  "site-axis sharding")
-            if psr:
-                raise ValueError("per-process selective loading does not "
-                                 "support PSR yet (per-site rate state is "
-                                 "host-global)")
 
         if branch_indices is None:
             branch_indices = [0] * self.num_parts
@@ -196,9 +192,15 @@ class LikelihoodEngine:
                                    psr=psr)
         # Per-site rate multipliers (PSR/CAT model); None selects the
         # GAMMA path in every kernel.  Placed like every per-site tensor
-        # (block axis sharded) so multi-process jobs hold a global array.
+        # (block axis sharded) so multi-process jobs hold a global
+        # array; under selective loading each process contributes only
+        # its block window (reference per-rank CAT state,
+        # `optimizeModel.c:2135-2254` — here the categorization itself
+        # is global on every process, see optimize/psr.py).
         self.site_rates = (self._put_blocks(
-            np.ones((B, lane, 1), dtype=self.dtype), lambda s: s.sites)
+            self._local_block_window(np.ones((B, lane, 1),
+                                             dtype=self.dtype)),
+            lambda s: s.sites)
             if psr else None)
 
         Bl = bucket.local_num_blocks
@@ -498,6 +500,17 @@ class LikelihoodEngine:
     # shards — host memory never sees the full width (the reference's
     # per-rank site slices, `byteFile.c:278-382`).
 
+    def _local_block_window(self, host_global: np.ndarray) -> np.ndarray:
+        """This process's contiguous block window of a GLOBAL block-axis
+        host array (identity on global buckets): the bridge between
+        host-global state (PSR rates, rate-scan grids — identical on
+        every process) and `_put_blocks`, which under selective loading
+        expects only the local window."""
+        if self.bucket.is_local:
+            o = self.bucket.block_offset
+            return host_global[o:o + self.bucket.local_num_blocks]
+        return host_global
+
     def _put_blocks(self, host: np.ndarray, pick):
         """Place a block-axis host array (full width, or the local window
         of a local bucket) under the sharding member pick selects."""
@@ -594,11 +607,13 @@ class LikelihoodEngine:
 
         `rates` is the GLOBAL array (identical on every process in a
         multi-host job); placement shards the block axis like every
-        other per-site tensor."""
+        other per-site tensor, and under selective loading only this
+        process's block window is materialized on its devices."""
         assert self.psr
         self.site_rates = self._put_blocks(
-            np.asarray(rates, dtype=self.dtype).reshape(
-                self.B, self.lane, 1), lambda s: s.sites)
+            self._local_block_window(
+                np.asarray(rates, dtype=self.dtype).reshape(
+                    self.B, self.lane, 1)), lambda s: s.sites)
 
     def _pallas_failed(self, exc: Exception) -> None:
         """Permanently demote this engine to the validated XLA fast path
@@ -659,36 +674,57 @@ class LikelihoodEngine:
         compile) runs as a timed, event-emitting compile monitor: on the
         axon/TPU remote-compile tunnel a pathological compile blocks in
         recv with no Python-level recourse (observed round 4: the chunk
-        program never returned), so after 180 s a daemon thread tells
-        the user WHICH program family is stuck and which escape hatch
-        pins the hardware-proven scan tier — through stderr AND the run
-        info file (obs log sink), so the operator need not guess.
-        Compile happens in C++ with the GIL released, so the timer
-        thread does run while the main thread is stuck.  Installed at
-        every fast-program cache miss, so recompiles after a
-        Mosaic-failure fallback (or LRU eviction) are guarded too.  The
-        first call is counted and timed into the registry
-        (engine.compile_count / engine.compile_seconds[.family]) and
-        emits a `compile:<family>` span — a wedged compile leaves the
-        span's unmatched "B" event as the trace's last line."""
+        program never returned), so after the compile deadline
+        (EXAML_COMPILE_TIMEOUT, the CLI's --compile-timeout; default
+        180 s) a daemon thread tells the user WHICH program family is
+        stuck and which escape hatch pins the hardware-proven scan tier
+        — through stderr AND the run info file (obs log sink), so the
+        operator need not guess.  Compile happens in C++ with the GIL
+        released, so the timer thread does run while the main thread is
+        stuck.  Installed at every fast-program cache miss, so
+        recompiles after a Mosaic-failure fallback (or LRU eviction)
+        are guarded too.  The first call is counted and timed into the
+        registry (engine.compile_count / engine.compile_seconds
+        [.family]) and emits a `compile:<family>` span — a wedged
+        compile leaves the span's unmatched "B" event as the trace's
+        last line.
+
+        Under `--bank` (ops/bank.py) this watchdog is the LAST line of
+        defense, not the first: every family compiles ahead of time in
+        a killable subprocess with a HARD deadline, and main-process
+        first calls run inside the bank phase as persistent-cache hits.
+        The wrapper attributes each first call accordingly
+        (engine.compile_count.bank_phase vs
+        engine.first_calls.banked/unbanked) so the run artifacts prove
+        where compile time was actually paid."""
         state = {"first": True}
 
         def call(*args):
             if not state["first"]:
                 return fn(*args)
             state["first"] = False
+            import os as _os
             import threading
             import time as _time
 
+            from examl_tpu.ops import bank
+
+            try:
+                limit = float(_os.environ.get("EXAML_COMPILE_TIMEOUT")
+                              or 180.0)
+            except ValueError:
+                limit = 180.0
             done = threading.Event()
 
             def bark():
-                if not done.wait(180.0):
+                if not done.wait(limit):
                     obs.inc("engine.watchdog_barks")
                     obs.log(
                         "EXAML: a device-program compile (program family "
-                        f"'{family}') has taken >180s — if this never "
-                        "returns, rerun with EXAML_FAST_TRAVERSAL=0 "
+                        f"'{family}') has taken >{limit:.0f}s — if this "
+                        "never returns, rerun with --bank (ahead-of-time "
+                        "banking kills wedged compiles and degrades to "
+                        "the scan tier), or pin EXAML_FAST_TRAVERSAL=0 "
                         "(scan tier), EXAML_PALLAS=0, or "
                         "EXAML_BATCH_SCAN=0 (sequential SPR scans), "
                         "depending on which program is compiling.")
@@ -704,6 +740,21 @@ class LikelihoodEngine:
                 obs.inc("engine.compile_count")
                 obs.inc("engine.compile_seconds", dt)
                 obs.inc(f"engine.compile_seconds.{family}", dt)
+                if bank.in_bank_phase():
+                    # Banked run, bank phase: the designed place for
+                    # every first call (compile time lives here, off
+                    # the search's critical path).
+                    obs.inc("engine.compile_count.bank_phase")
+                    obs.inc("engine.compile_seconds.bank_phase", dt)
+                elif bank.active():
+                    # Banked run, search phase: a banked family minting
+                    # a new shape variant is expected (persistent-cache
+                    # hit); an UNBANKED first call means the bank's
+                    # enumeration missed a family — the acceptance
+                    # counter for wedge immunity.
+                    obs.inc("engine.first_calls.banked"
+                            if bank.is_banked(family)
+                            else "engine.first_calls.unbanked")
 
         return call
 
@@ -1289,8 +1340,12 @@ class LikelihoodEngine:
         obs.inc("engine.traversal_entries", len(entries))
         tv = self._traversal_arrays(entries)
         zv = jnp.asarray(_z_slots(z, self.num_branch_slots), dtype=self.dtype)
+        # `grid` is GLOBAL [B, lane, G] (every process builds the same
+        # one from the host-global patrat); a selective-loading process
+        # contributes only its block window to the sharded device array.
         grid_dev = self._put_blocks(
-            np.asarray(grid, dtype=self.dtype), lambda s: s.sites)
+            self._local_block_window(np.asarray(grid, dtype=self.dtype)),
+            lambda s: s.sites)
         with obs.device_span("engine:rate_scan",
                              args={"grid": int(grid.shape[-1])}):
             out = self._jit_rate_scan(

@@ -79,8 +79,10 @@ def _scan_partition_rates(inst: PhyloInstance, tree: Tree,
         # patterns crawl further before the epsilon stop, and the
         # categorization ranks rate groups by weighted mass — without it
         # the near-zero-rate category never wins a slot and PSR lands
-        # ~400 lnL short on testData/49.
-        w = bucket.weights.reshape(bucket.num_blocks, bucket.lane)
+        # ~400 lnL short on testData/49.  GLOBAL view (one allgather of
+        # the per-process windows under selective loading) because the
+        # crawl and categorization run on global arrays everywhere.
+        w = inst.psr_packed_weights(bucket)
 
         def eval_offsets(offs):
             grid = r0[:, :, None] + offs[None, None, :]
@@ -209,16 +211,18 @@ def _normalize_mean_rate(inst: PhyloInstance) -> None:
     (reference `updatePerSiteRates`, `optimizeModel.c:2060-2120`)."""
     parts = inst.alignment.partitions
     if inst.num_branch_slots > 1:
-        for gid, part in enumerate(parts):
+        for gid in range(len(parts)):
+            w = inst.psr_pattern_weights(gid)   # GLOBAL under slicing
             rates = inst.per_site_rates[gid][inst.rate_category[gid]]
-            mean = float(part.weights @ rates) / float(part.weights.sum())
+            mean = float(w @ rates) / float(w.sum())
             inst.per_site_rates[gid] = inst.per_site_rates[gid] / mean
     else:
         num = den = 0.0
-        for gid, part in enumerate(parts):
+        for gid in range(len(parts)):
+            w = inst.psr_pattern_weights(gid)   # GLOBAL under slicing
             rates = inst.per_site_rates[gid][inst.rate_category[gid]]
-            num += float(part.weights @ rates)
-            den += float(part.weights.sum())
+            num += float(w @ rates)
+            den += float(w.sum())
         scale = num / den
         for gid in range(len(parts)):
             inst.per_site_rates[gid] = inst.per_site_rates[gid] / scale
@@ -306,17 +310,19 @@ def refine_category_rates(inst: PhyloInstance, tree: Tree,
     C = inst.num_branch_slots
     if C > 1:
         mexp = np.ones(C)
-        for gid, part in enumerate(parts):
+        for gid in range(len(parts)):
+            w = inst.psr_pattern_weights(gid)   # GLOBAL under slicing
             rates = inst.per_site_rates[gid][inst.rate_category[gid]]
-            m = float(part.weights @ rates) / float(part.weights.sum())
+            m = float(w @ rates) / float(w.sum())
             inst.per_site_rates[gid] = inst.per_site_rates[gid] / m
             mexp[gid] = m
     else:
         num = den = 0.0
-        for gid, part in enumerate(parts):
+        for gid in range(len(parts)):
+            w = inst.psr_pattern_weights(gid)   # GLOBAL under slicing
             rates = inst.per_site_rates[gid][inst.rate_category[gid]]
-            num += float(part.weights @ rates)
-            den += float(part.weights.sum())
+            num += float(w @ rates)
+            den += float(w.sum())
         mexp = np.full(1, num / den)
         for gid in range(inst.num_parts):
             inst.per_site_rates[gid] = inst.per_site_rates[gid] / mexp[0]

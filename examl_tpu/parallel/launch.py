@@ -65,6 +65,35 @@ def init_distributed(args, log=lambda msg: None) -> None:
         f"{jax.device_count()} global devices")
 
 
+def bank_barrier(args, log=lambda msg: None) -> None:
+    """Synchronize a multi-host job after per-process program banking
+    (ops/bank.py): each process banks against its OWN host's persistent
+    cache (local disk, local CPU fingerprint), and no process may enter
+    the collective SPMD program while a peer is still compiling — a
+    straggler inside a collective looks exactly like the wedge banking
+    exists to prevent.  The reference's analogue is MPI_Barrier after
+    per-rank setup (`axml.c: main` before the first Allreduce).
+
+    Single-process runs (and jaxlib builds without multi-process
+    collectives on this backend) fall through: the first collective
+    dispatch then synchronizes, as before banking existed."""
+    if getattr(args, "nprocs", None) is None and \
+            getattr(args, "coordinator", None) is None:
+        return
+    import jax
+
+    if jax.process_count() <= 1:
+        return
+    try:
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices("examl_bank")
+        log(f"bank: {jax.process_count()} processes banked "
+            "(barrier passed)")
+    except Exception as exc:                 # noqa: BLE001
+        log(f"bank: cross-process barrier unavailable ({exc}); the "
+            "first collective dispatch will synchronize instead")
+
+
 def enable_process_tracing(trace_dir: str,
                            log=lambda msg: None) -> Optional[str]:
     """Open this process's span-trace file under `trace_dir`, named by

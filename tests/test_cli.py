@@ -148,6 +148,9 @@ def test_selective_read_decision_table():
     assert d("GAMMA", True, False, 4)[0] == "slice"
     assert d("GAMMA", False, False, 4)[0] == "whole"    # raw PHYLIP
     assert d("GAMMA", True, True, 4)[0] == "whole"      # AUTO protein
-    assert d("PSR", True, False, 4)[0] == "whole"       # allgathered scan
+    # PSR now slices too: per-site rate state is host-global via
+    # allgathers (engine.rate_scan output + the one-time packed-weight
+    # gather), so per-process reads are safe — VERDICT Weak §6 lifted.
+    assert d("PSR", True, False, 4)[0] == "slice"
     assert d("PSR", True, False, 1)[0] == "whole"       # single-proc PSR ok
     assert d("GAMMA", True, False, 4, save_memory=True)[0] == "slice"  # -S

@@ -1,0 +1,70 @@
+"""Tiny CPU `--bank` smoke (CI gate, .github/workflows/ci.yml).
+
+Synthesizes an 8-taxon DNA alignment, runs the CLI driver with
+ahead-of-time program banking enabled, and asserts the banking
+invariants: the run completes, at least the scan-tier core families
+bank, the main process performs its first-call compiles inside the bank
+phase, and no unbanked first call or watchdog bark occurs afterwards.
+
+    JAX_PLATFORMS=cpu python tools/bank_smoke.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main() -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import numpy as np
+
+    from examl_tpu.cli.main import main as cli_main
+    from examl_tpu.instance import PhyloInstance
+    from examl_tpu.io.alignment import build_alignment_data
+    from examl_tpu.io.bytefile import write_bytefile
+
+    rng = np.random.default_rng(5)
+    names = [f"t{i}" for i in range(8)]
+    seqs = ["".join("ACGT"[b] for b in rng.integers(0, 4, 200))
+            for _ in names]
+    data = build_alignment_data(names, seqs)
+    with tempfile.TemporaryDirectory() as d:
+        bf = os.path.join(d, "tiny.binary")
+        write_bytefile(bf, data)
+        tree = PhyloInstance(data).random_tree(5)
+        tf = os.path.join(d, "tiny.tree")
+        with open(tf, "w") as f:
+            f.write(tree.to_newick(names))
+        metrics = os.path.join(d, "metrics.json")
+        rc = cli_main(["-s", bf, "-n", "SMOKE", "-t", tf, "-f", "e",
+                       "-w", os.path.join(d, "out"), "--bank",
+                       "--compile-timeout", "300", "--metrics", metrics,
+                       "--single-device"])
+        if rc != 0:
+            print(f"bank smoke: CLI exited rc={rc}", file=sys.stderr)
+            return 1
+        c = json.load(open(metrics))["counters"]
+        checks = [
+            ("bank.banked", c.get("bank.banked", 0) >= 5),
+            ("compiles in bank phase",
+             c.get("engine.compile_count.bank_phase", 0) > 0),
+            ("zero unbanked first calls",
+             c.get("engine.first_calls.unbanked", 0) == 0),
+            ("zero watchdog barks",
+             c.get("engine.watchdog_barks", 0) == 0),
+        ]
+        ok = True
+        for name, passed in checks:
+            print(f"bank smoke: {name}: {'ok' if passed else 'FAIL'}")
+            ok &= passed
+        return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
