@@ -724,21 +724,14 @@ def _child_env(cpu: bool) -> dict:
 
 
 def _exit_desc(rc) -> str:
-    """Human-readable worker exit cause (duplicated from ops/bank.py on
-    purpose: the bench PARENT must not import examl_tpu/jax — a broken
-    accelerator plugin can hang the importing process, which is why the
-    backend probe runs in a subprocess).  Negative returncodes name
-    their signal so "worker exited" distinguishes a SIGILL (mis-featured
-    cached kernel, the r05 killer) from an OOM kill from a hang-kill."""
-    if rc is None:
-        return "(hang-killed)"
-    if rc < 0:
-        import signal
-        try:
-            return f"(signal {signal.Signals(-rc).name})"
-        except ValueError:
-            return f"(signal {-rc})"
-    return f"(returncode {rc})"
+    """Worker exit cause via the shared taxonomy
+    (examl_tpu/resilience/exitcause.py, stdlib-only BY CONTRACT: the
+    bench parent must never import jax — a broken accelerator plugin
+    can hang the importing process, which is why the backend probe runs
+    in a subprocess).  The bench's rc-None semantics name the action it
+    just took: the worker was hang-killed."""
+    from examl_tpu.resilience.exitcause import exit_desc
+    return exit_desc(rc, none_desc="(hang-killed)")
 
 
 def _merge_metrics(results: dict, snapshot: dict) -> None:

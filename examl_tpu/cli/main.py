@@ -79,6 +79,37 @@ def build_argparser() -> argparse.ArgumentParser:
                          "(kill + scan-tier fallback) for --bank "
                          "workers, watchdog-bark threshold for any "
                          "in-process compile (default 180)")
+    ap.add_argument("--supervise", dest="supervise", action="store_true",
+                    help="self-healing supervision: run the search as a "
+                         "killable child, watch its search-loop "
+                         "heartbeat, and on crash/stall restart from "
+                         "the newest checkpoint with capped retries, "
+                         "backoff and escalating degradation pins "
+                         "(pallas->chunk->scan); SIGTERM/SIGINT "
+                         "preemptions resume without consuming a retry")
+    ap.add_argument("--supervise-retries", dest="supervise_retries",
+                    type=int, default=3,
+                    help="max failure restarts under --supervise "
+                         "(preemption resumes are not counted; "
+                         "default 3)")
+    ap.add_argument("--supervise-stall", dest="supervise_stall",
+                    type=float, default=300.0,
+                    help="seconds without a search-loop heartbeat "
+                         "before the supervisor declares a dispatch/"
+                         "collective wedge and kills the child "
+                         "(default 300; 0 disables stall detection)")
+    ap.add_argument("--supervise-backoff", dest="supervise_backoff",
+                    type=float, default=2.0,
+                    help="base seconds for the supervisor's exponential "
+                         "restart backoff (default 2)")
+    ap.add_argument("--inject-fault", dest="inject_fault",
+                    action="append", metavar="SPEC", default=None,
+                    help="arm a named fault-injection point (repeatable; "
+                         "resilience/faults.py): "
+                         "point[:after=N][:attempt=K][:signal=NAME]"
+                         "[:hang[=S]] — e.g. search.kill:after=10 or "
+                         "heartbeat.stall:after=5; equivalent to "
+                         "EXAML_FAULTS entries")
     ap.add_argument("--profile", dest="profile_dir", default=None,
                     help="write a jax profiler trace to this directory "
                          "(SURVEY §5.1; view with xprof/tensorboard)")
@@ -361,6 +392,11 @@ def run_search(args, inst, files: RunFiles) -> int:
         if conv is not None:
             extras = dict(extras, rf_history=conv.to_blob())
         inner_cb(state, extras)
+        # Preemption cadence: the checkpoint just written is coherent,
+        # so a pending SIGTERM/SIGINT exits resumable HERE (raises
+        # PreemptCheckpointed -> EXIT_PREEMPTED in main).
+        from examl_tpu.resilience import preempt
+        preempt.check_after_checkpoint(log=files.info)
 
     res = compute_big_rapid(inst, tree, opts, convergence_cb=conv,
                             checkpoint_cb=checkpoint_cb,
@@ -455,13 +491,16 @@ def run_tree_evaluation(args, inst, files: RunFiles) -> int:
         inst.evaluate(tree, full=True)
 
         def ckpt_cb(state: str, extras: dict, i=i, tree=tree) -> None:
-            if time.time() - last_ckpt[0] < 60.0:
+            from examl_tpu.resilience import preempt
+            if (time.time() - last_ckpt[0] < 60.0
+                    and not preempt.requested()):
                 return                      # mid-tree cadence: >= 60 s apart
-            merged = dict(extras)
-            merged.update(tree_iteration=i, results=results, lnls=lnls,
-                          mid_tree=True)
+            merged = dict(extras)           # (a pending preemption writes
+            merged.update(tree_iteration=i,  # regardless of the cadence)
+                          results=results, lnls=lnls, mid_tree=True)
             mgr.write(state, merged, inst, tree)
             last_ckpt[0] = time.time()
+            preempt.check_after_checkpoint(log=files.info)
 
         if fast and i > 0:
             tree_evaluate(inst, tree, 2.0)
@@ -476,6 +515,9 @@ def run_tree_evaluation(args, inst, files: RunFiles) -> int:
         mgr.write("MOD_OPT", {"tree_iteration": i + 1, "results": results,
                               "lnls": lnls}, inst, tree)
         last_ckpt[0] = time.time()
+        from examl_tpu.resilience import heartbeat, preempt
+        heartbeat.beat("TREE_EVAL")
+        preempt.check_after_checkpoint(log=files.info)
     best = max(range(len(lnls)), key=lambda i: lnls[i])
     files.info(f"Evaluated {len(lnls)} trees; best is tree {best} "
                f"with likelihood {lnls[best]:.6f}")
@@ -503,6 +545,7 @@ def _packing_report(inst, files: RunFiles) -> None:
 
 
 def main(argv=None) -> int:
+    raw_argv = list(argv) if argv is not None else sys.argv[1:]
     ap = build_argparser()
     args = ap.parse_args(argv)
 
@@ -518,16 +561,40 @@ def main(argv=None) -> int:
         ap.error('you must specify either "-r randomQuartetNumber" or '
                  '"-Y quartetGroupingFileName"')
 
+    from examl_tpu.resilience import faults as _faults
+    if args.inject_fault:
+        try:                         # validate at argument time, arm later
+            _faults.parse_spec(",".join(args.inject_fault))
+        except ValueError as exc:
+            ap.error(f"--inject-fault: {exc}")
+
+    if args.supervise:
+        # Self-healing supervision: this process becomes a thin, jax-free
+        # watcher (resilience/supervisor.py) and the ENTIRE run — faults,
+        # banking, search — happens in killable child processes.  The
+        # child gets the original argv minus the supervisor flags;
+        # --inject-fault passes through so the child arms the registry.
+        from examl_tpu.resilience import supervisor as _supervisor
+        return _supervisor.supervise(raw_argv, args, log=print)
+
     from examl_tpu import obs
     from examl_tpu.parallel.launch import (enable_process_tracing,
                                            init_distributed)
+    from examl_tpu.resilience import heartbeat as _heartbeat
+    from examl_tpu.resilience import preempt as _preempt
 
     # One run = one metrics record: callers invoking main() repeatedly in
     # a single process (tests) must not accumulate counters across runs
-    # (nor inherit a previous run's bank verdicts).
+    # (nor inherit a previous run's bank verdicts, fault hit-counts, or
+    # heartbeat stream).
     obs.reset()
     from examl_tpu.ops import bank as _bank
     _bank.reset()
+    _faults.reset()
+    _heartbeat.reset()
+    prior_faults_env = os.environ.get(_faults.ENV_VAR)
+    for spec in (args.inject_fault or []):
+        _faults.arm(spec)
     # One deadline definition for every compile monitor: the bank
     # workers' hard per-family kill AND the in-process watchdog bark
     # read the same knob (exported so subprocess workers inherit it).
@@ -555,8 +622,21 @@ def main(argv=None) -> int:
     if args.profile_dir or args.trace_events_dir:
         obs.set_annotations(True)
     obs.set_log_sink(files.info)
+    # Preemption safety: SIGTERM/SIGINT only SET A FLAG; the search
+    # loop's checkpoint cadence turns it into an emergency checkpoint
+    # and a clean resumable exit (EXIT_PREEMPTED) — no-op off the main
+    # thread (threaded test drivers).  Heartbeats publish to
+    # $EXAML_HEARTBEAT_FILE when set (the supervisor sets it).
+    preempt_installed = _preempt.install(log=obs.log)
+    from examl_tpu.parallel.launch import install_heartbeat
+    install_heartbeat(args, log=files.info)
     try:
         return _run(args, files)
+    except _preempt.PreemptCheckpointed as exc:
+        files.info(f"run preempted ({exc.signame}): emergency checkpoint "
+                   "written; restart with -R to resume (a --supervise "
+                   "parent resumes automatically)")
+        return _preempt.EXIT_PREEMPTED
     finally:
         # The metrics snapshot and trace finalize must survive FAILED
         # runs — a wedged compile or mid-search crash is exactly when
@@ -575,6 +655,16 @@ def main(argv=None) -> int:
         obs.set_log_sink(None)       # don't leak this run's info file
         obs.set_annotations(False)   # no TraceAnnotation cost after the run
         obs.finalize_tracing()
+        if preempt_installed:
+            _preempt.uninstall()
+        _heartbeat.reset()
+        # --inject-fault arming is per-run: restore the env so repeated
+        # in-process main() calls (tests) never inherit armed faults.
+        if args.inject_fault:
+            if prior_faults_env is None:
+                os.environ.pop(_faults.ENV_VAR, None)
+            else:
+                os.environ[_faults.ENV_VAR] = prior_faults_env
 
 
 def _run(args, files: RunFiles) -> int:

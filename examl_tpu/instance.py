@@ -281,12 +281,18 @@ class PhyloInstance:
             tree.invalidate_all()
         entries = self._collect(tree, p, full) + self._collect(tree, q, full)
         per_part = self.per_partition_lnl
+        from examl_tpu.resilience import faults
+        faults.fire("engine.dispatch")
         for states, eng in self.engines.items():
             if only_states is not None and states not in only_states:
                 continue
             # Fused traversal + root evaluation: one dispatch per engine.
             vals = eng.traverse_evaluate(entries, p.number, q.number, p.z,
                                          full=full)
+            if faults.fire("engine.nonfinite"):
+                vals = np.full_like(np.asarray(vals, dtype=float), np.nan)
+            if not np.all(np.isfinite(vals)):
+                vals = self._nonfinite_retry(tree, eng, p, q)
             for li, gid in enumerate(eng.bucket.part_ids):
                 per_part[gid] = vals[li]
         if only_states is not None and np.isnan(per_part).any():
@@ -295,6 +301,38 @@ class PhyloInstance:
                 "per-partition lnL is uninitialized for the skipped buckets")
         self.likelihood = float(per_part.sum())
         return self.likelihood
+
+    def _nonfinite_retry(self, tree: Tree, eng, p: Node, q: Node):
+        """Non-finite guard at the dispatch boundary: a NaN/−inf lnL
+        from one engine means poisoned CLVs or a miscompiled fast-tier
+        program (bf16 underflow past the rescaler, a bad cached kernel)
+        — not a recoverable search state.  Retry ONCE on the scan tier
+        with a full recompute of this engine's CLVs (the one program
+        hardware-proven on every backend, the same escape hatch the
+        bank pins); a second non-finite result is a hard error — a
+        search step taken on a poisoned lnL silently corrupts the tree.
+        Counted as engine.nonfinite_retries / .nonfinite_recovered."""
+        from examl_tpu import obs
+        obs.inc("engine.nonfinite_retries")
+        obs.log(f"EXAML: non-finite lnL from the states={eng.bucket.states} "
+                "engine; recomputing once on the scan tier")
+        prior = eng.force_scan
+        eng.force_scan = True
+        try:
+            tree.invalidate_all()
+            entries = (self._collect(tree, p, True)
+                       + self._collect(tree, q, True))
+            vals = eng.traverse_evaluate(entries, p.number, q.number, p.z,
+                                         full=True)
+        finally:
+            eng.force_scan = prior
+        if not np.all(np.isfinite(vals)):
+            raise FloatingPointError(
+                "non-finite log-likelihood persists on the scan-tier "
+                f"retry (states={eng.bucket.states}); refusing to search "
+                "on a poisoned lnL")
+        obs.inc("engine.nonfinite_recovered")
+        return vals
 
     # -- branch-length optimization (Newton-Raphson) ------------------------
 

@@ -68,6 +68,11 @@ def snapshot() -> dict:
     return _metrics.registry().snapshot()
 
 
+def snapshot_counters() -> dict:
+    """Counters only, no collectors — safe on hot loops (heartbeat)."""
+    return _metrics.registry().snapshot_counters()
+
+
 def reset() -> None:
     _metrics.registry().reset()
 

@@ -17,9 +17,16 @@ Naming convention (dotted, lowercase):
   engine.first_calls.banked/unbanked   post-bank first calls by verdict
   engine.pallas_fallbacks      Mosaic -> XLA demotions
   engine.watchdog_barks        compile-deadline watchdog firings
+  engine.nonfinite_retries/.nonfinite_recovered   NaN-lnL scan-tier retries
   bank.families/banked/timeouts/errors/skipped/fallbacks   AOT banking
   bank.compile.<family>        per-family subprocess compile (timers)
   bank.engine.*                worker-side compile counters, merged
+  resilience.heartbeats        published search-loop liveness beats
+  resilience.restarts/heartbeat_stalls/preempts   supervisor (merged
+                               into the --metrics snapshot at exit)
+  resilience.preempt_checkpoints   emergency checkpoints before exit 75
+  checkpoint.corrupt_skipped   unreadable checkpoints skipped at restore
+  faults.fired.<point>         injected faults that fired (chaos tests)
   search.spr_cycles, search.fast_cycles, search.thorough_cycles
   search.scan_dispatches, search.scan_candidates
   phase.<name>                 CLI wall-clock phases (timers)
@@ -146,6 +153,14 @@ class MetricsRegistry:
                 "gauges": dict(self._gauges),
                 "timers": {n: t.as_dict() for n, t in self._timers.items()},
             }
+
+    def snapshot_counters(self) -> Dict[str, float]:
+        """Counters only, WITHOUT running collectors: the cheap form for
+        high-frequency consumers (the resilience heartbeat embeds this
+        in every published beat — collectors may touch device state and
+        must not run on the search loop's iteration clock)."""
+        with self._lock:
+            return dict(self._counters)
 
     def reset(self) -> None:
         """Clear counters/gauges/timers (collectors stay registered —

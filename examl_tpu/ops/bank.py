@@ -60,6 +60,8 @@ import time
 from typing import Dict, List, Optional
 
 from examl_tpu import obs
+from examl_tpu.resilience import faults
+from examl_tpu.resilience.exitcause import exit_desc
 
 # Families with no in-run fallback: they ARE the scan tier (wave-batched
 # lax.scan programs) every degradation lands on.  A timeout here is
@@ -394,6 +396,11 @@ def _worker(spec_path: str, families: List[str]) -> None:
         print(f"##start {family}", flush=True)
         if family in hang:                    # test hook: a wedged compile
             time.sleep(3600)
+        # Fault seam (resilience/faults.py): `bank.worker` kills or
+        # hangs THIS worker at family start — the parent's deadline
+        # kill, mid-compile-death classification and requeue paths are
+        # all exercisable on CPU (EXAML_FAULTS propagates via env).
+        faults.fire("bank.worker")
         try:
             reason = _applicability(inst, family)
             if reason is not None:
@@ -716,18 +723,9 @@ def run_bank(args, log=lambda msg: None, timeout: Optional[float] = None,
 
 
 def _exit_desc(rc: Optional[int]) -> str:
-    """Human-readable exit cause: negative returncodes name their signal
-    (SIGILL from a mis-featured cached kernel reads differently from a
-    SIGKILL hang-kill or an OOM SIGTERM)."""
-    if rc is None:
-        return "(still running)"
-    if rc < 0:
-        import signal
-        try:
-            return f"(signal {signal.Signals(-rc).name})"
-        except ValueError:
-            return f"(signal {-rc})"
-    return f"(returncode {rc})"
+    """Worker exit cause — the shared taxonomy (resilience/exitcause.py)
+    with the bank's poll semantics (rc None = still running)."""
+    return exit_desc(rc, none_desc="(still running)")
 
 
 def _merge_worker_metrics(snapshot: dict) -> None:

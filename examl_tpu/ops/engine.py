@@ -733,6 +733,13 @@ class LikelihoodEngine:
             t0 = _time.perf_counter()
             try:
                 with obs.span(f"compile:{family}", cat="compile"):
+                    # Fault seam: `compile.hang` sleeps here (default
+                    # 3600 s), making the first call indistinguishable
+                    # from a wedged remote compile — the watchdog bark,
+                    # bank deadline-kill and supervisor paths are all
+                    # exercisable on CPU through this one line.
+                    from examl_tpu.resilience import faults
+                    faults.fire("compile.hang")
                     return fn(*args)
             finally:
                 done.set()

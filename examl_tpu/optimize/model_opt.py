@@ -256,6 +256,12 @@ def mod_opt(inst: PhyloInstance, tree: Tree, likelihood_epsilon: float,
         current = inst.likelihood
         rounds += 1
         obs.inc("search.model_opt_rounds")
+        # Optimizer rounds are search-loop iterations too: model
+        # optimization between SPR phases can run minutes on large
+        # data, and a wedge inside it must freeze the liveness clock
+        # the supervisor watches (resilience/heartbeat.py).
+        from examl_tpu.resilience import heartbeat
+        heartbeat.beat("MOD_OPT")
         with obs.span("opt:model_opt_round", args={"round": rounds}):
             dbg("start")
             opt_rates(inst, tree)

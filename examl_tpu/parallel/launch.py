@@ -21,6 +21,7 @@ the reference's Allreduce, inserted by the compiler.
 
 from __future__ import annotations
 
+import os
 from typing import Optional
 
 from examl_tpu.parallel.sharding import (SiteSharding, make_mesh,
@@ -92,6 +93,30 @@ def bank_barrier(args, log=lambda msg: None) -> None:
     except Exception as exc:                 # noqa: BLE001
         log(f"bank: cross-process barrier unavailable ({exc}); the "
             "first collective dispatch will synchronize instead")
+
+
+def install_heartbeat(args, log=lambda msg: None) -> Optional[str]:
+    """Point this process's search-loop heartbeat at a PER-PROCESS file
+    (resilience/heartbeat.py, `$EXAML_HEARTBEAT_FILE`).  Process 0
+    keeps the configured path — its supervisor watches exactly that
+    file; processes >0 of a multi-host job append `.p<procid>` so the
+    job's beats never clobber one file (one shared file would mask a
+    single wedged peer behind its neighbors' beats).  Call AFTER
+    init_distributed so the procid is the job's, not a guess."""
+    from examl_tpu.resilience import heartbeat
+
+    base = os.environ.get(heartbeat.ENV_VAR)
+    if not base:
+        return None
+    path = base
+    if getattr(args, "nprocs", None) is not None or \
+            getattr(args, "coordinator", None) is not None:
+        import jax
+        if jax.process_index() != 0:
+            path = f"{base}.p{jax.process_index()}"
+    path = heartbeat.install(path)
+    log(f"heartbeat -> {path}")
+    return path
 
 
 def enable_process_tracing(trace_dir: str,

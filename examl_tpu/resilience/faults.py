@@ -1,0 +1,209 @@
+"""Deterministic fault injection (stdlib-only by contract).
+
+Every recovery path in this runtime — supervisor restart, checkpoint
+fallback, non-finite retry, bank worker kill, watchdog bark — must be
+testable on CPU without waiting for real hardware to misbehave.  This
+module is a registry of NAMED injection points wired into the real
+seams; arming one makes the seam fail exactly the way the observed
+failure mode does.
+
+Arming (comma-separated specs, via `EXAML_FAULTS` or `--inject-fault`):
+
+    point[:after=N][:attempt=K][:signal=NAME][:hang[=SECS]][:raise]
+
+* `after=N`   — fire on the Nth check of the point (default 1).
+* `attempt=K` — fire only when `EXAML_RESTART_COUNT` == K (default 0,
+  i.e. only the supervisor's FIRST attempt; `attempt=*` fires on every
+  attempt).  This is what lets a supervised chaos run crash once and
+  then complete: the retry's environment carries RESTART_COUNT=1.
+* `signal=NAME` / `hang[=SECS]` / `raise` override the point's default
+  action: signal self (KILL/TERM/ILL/SEGV/...), sleep, or raise
+  `FaultInjected`.
+
+Registered points (seam → default action):
+
+    engine.dispatch    instance.evaluate, before dispatch     → raise
+    engine.nonfinite   instance.evaluate, poisons lnL to NaN  → flag
+    compile.hang       engine._guard_first_call first call    → hang
+    checkpoint.write   CheckpointManager.write, pre-publish   → raise
+    bank.worker        ops/bank worker, at family start       → signal KILL
+    search.kill        heartbeat.beat (per search iteration)  → signal KILL
+    heartbeat.stall    heartbeat.beat, sticky beat suppressor → flag
+
+`flag` points have no side effect here — `fire()` returns True and the
+seam implements the failure (NaN substitution, beat suppression).
+"""
+
+from __future__ import annotations
+
+import os
+import signal as _signal
+import time
+from dataclasses import dataclass
+from typing import Dict, Optional
+
+ENV_VAR = "EXAML_FAULTS"
+ATTEMPT_VAR = "EXAML_RESTART_COUNT"
+
+POINTS = {
+    "engine.dispatch": "raise at the engine dispatch boundary",
+    "engine.nonfinite": "poison the dispatched log-likelihood with NaN",
+    "compile.hang": "hang inside the first-call compile monitor",
+    "checkpoint.write": "fail a checkpoint write before publish",
+    "bank.worker": "kill/hang a bank compile worker at family start",
+    "search.kill": "signal self at the Nth search-loop heartbeat",
+    "heartbeat.stall": "stop emitting heartbeats (sticky)",
+}
+
+_DEFAULT_ACTION = {
+    "compile.hang": ("hang", 3600.0),
+    "bank.worker": ("signal", "KILL"),
+    "search.kill": ("signal", "KILL"),
+    "engine.nonfinite": ("flag", None),
+    "heartbeat.stall": ("flag", None),
+}
+
+_STICKY = frozenset({"heartbeat.stall"})
+
+
+class FaultInjected(RuntimeError):
+    """Raised by `raise`-action injection points."""
+
+
+@dataclass
+class FaultSpec:
+    point: str
+    after: int = 1
+    attempt: Optional[int] = 0          # None = every attempt ("*")
+    action: str = "raise"               # raise | signal | hang | flag
+    arg: object = None                  # signal name / hang seconds
+
+
+def parse_spec(text: str) -> Dict[str, FaultSpec]:
+    """Parse an EXAML_FAULTS value into {point: FaultSpec}.
+
+    Unknown points raise ValueError — a typo'd injection that silently
+    never fires would make a chaos test pass vacuously.
+    """
+    specs: Dict[str, FaultSpec] = {}
+    for item in (text or "").split(","):
+        item = item.strip()
+        if not item:
+            continue
+        fields = item.split(":")
+        point = fields[0]
+        if point not in POINTS:
+            raise ValueError(
+                f"unknown fault point {point!r} (known: "
+                + ", ".join(sorted(POINTS)) + ")")
+        action, arg = _DEFAULT_ACTION.get(point, ("raise", None))
+        spec = FaultSpec(point=point, action=action, arg=arg)
+        for f in fields[1:]:
+            key, _, val = f.partition("=")
+            if key == "after":
+                spec.after = max(1, int(val))
+            elif key == "attempt":
+                spec.attempt = None if val == "*" else int(val)
+            elif key == "signal":
+                spec.action, spec.arg = "signal", (val or "KILL").upper()
+            elif key == "hang":
+                spec.action = "hang"
+                spec.arg = float(val) if val else 3600.0
+            elif key == "raise":
+                spec.action, spec.arg = "raise", None
+            else:
+                raise ValueError(f"unknown fault field {f!r} in {item!r}")
+        specs[point] = spec
+    return specs
+
+
+# Process state: specs are re-parsed whenever the env text changes (the
+# CLI merges --inject-fault into EXAML_FAULTS; tests monkeypatch it),
+# hit counters persist for the life of the process, sticky points stay
+# fired once triggered.
+_STATE = {"raw": None, "specs": {}, "hits": {}, "fired": set()}
+
+
+def reset() -> None:
+    """Clear hit counters and sticky state (one CLI run = one fault
+    record; tests invoking main() repeatedly must not inherit counts)."""
+    _STATE.update(raw=None, specs={}, hits={}, fired=set())
+
+
+def _specs() -> Dict[str, FaultSpec]:
+    raw = os.environ.get(ENV_VAR, "")
+    if raw != _STATE["raw"]:
+        _STATE["raw"] = raw
+        try:
+            _STATE["specs"] = parse_spec(raw)
+        except ValueError as exc:
+            # An unparseable env must be loud but not fatal mid-seam.
+            import sys
+            sys.stderr.write(f"EXAML: ignoring {ENV_VAR}: {exc}\n")
+            _STATE["specs"] = {}
+    return _STATE["specs"]
+
+
+def arm(spec_text: str) -> None:
+    """Append spec(s) to the environment registry (validates eagerly, so
+    `--inject-fault typo.point` fails at argument time, not mid-run)."""
+    parse_spec(spec_text)
+    prior = os.environ.get(ENV_VAR, "")
+    os.environ[ENV_VAR] = (prior + "," if prior else "") + spec_text
+
+
+def _attempt() -> int:
+    try:
+        return int(os.environ.get(ATTEMPT_VAR, "0") or 0)
+    except ValueError:
+        return 0
+
+
+def armed(point: str) -> Optional[FaultSpec]:
+    """Check (and count) one hit of `point`; the spec when THIS hit
+    fires, else None.  Sticky points keep firing once triggered."""
+    spec = _specs().get(point)
+    if spec is None:
+        return None
+    if spec.attempt is not None and _attempt() != spec.attempt:
+        return None
+    if point in _STATE["fired"] and point in _STICKY:
+        return spec
+    hits = _STATE["hits"].get(point, 0) + 1
+    _STATE["hits"][point] = hits
+    if hits != spec.after:
+        return None
+    _STATE["fired"].add(point)
+    return spec
+
+
+def fire(point: str) -> bool:
+    """Check `point` and perform its action.  Returns False when not
+    armed; True for `flag` points (the seam implements the failure);
+    raises / signals / hangs otherwise."""
+    spec = armed(point)
+    if spec is None:
+        return False
+    try:                              # count fired faults when obs exists
+        from examl_tpu import obs
+        obs.inc(f"faults.fired.{point}")
+        obs.log(f"EXAML: fault injection: {point} fired "
+                f"(action {spec.action})")
+    except Exception:                 # noqa: BLE001 — stdlib-only callers
+        pass
+    if spec.action == "flag":
+        return True
+    if spec.action == "hang":
+        time.sleep(float(spec.arg or 3600.0))
+        return True
+    if spec.action == "signal":
+        name = str(spec.arg or "KILL")
+        sig = getattr(_signal, "SIG" + name, None) \
+            if not name.startswith("SIG") else getattr(_signal, name, None)
+        if sig is None:
+            raise ValueError(f"unknown signal {name!r} for fault {point}")
+        os.kill(os.getpid(), int(sig))
+        # A non-fatal signal (TERM with a handler installed) returns:
+        # the seam continues and the handler's flag does the rest.
+        return True
+    raise FaultInjected(f"injected fault at {point}")

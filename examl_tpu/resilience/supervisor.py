@@ -1,0 +1,389 @@
+"""Self-healing run supervisor (`--supervise`): converts "lost window"
+into "resumed run".
+
+The supervisor is a thin, jax-free parent (IMPORT CONTRACT in the
+package `__init__`: on exclusive-access accelerators the parent must
+never take the device handle the child needs, and a hung accelerator
+plugin must not be able to hang the watcher).  It:
+
+* runs the search CLI as a KILLABLE child in its own process group
+  (`python -m examl_tpu.cli.main`, `--supervise` stripped);
+* exports `EXAML_HEARTBEAT_FILE` and watches it — once the search loop
+  starts beating, a stall longer than `--supervise-stall` means a
+  dispatch/collective wedge (the class the compile watchdog cannot
+  see) and the whole child process group is SIGKILLed;
+* classifies every death through the shared exit taxonomy
+  (`resilience/exitcause.py`: SIGILL vs OOM vs hang-kill vs preempt);
+* restarts from the newest checkpoint (`-R` once one exists) with
+  capped retries, exponential backoff, and ESCALATING degradation pins
+  mirroring the bank's escape hatches: retry 1 pins `EXAML_PALLAS=0`
+  (pallas→chunk), retry 2+ pins the scan tier
+  (`EXAML_FAST_TRAVERSAL=0`, `EXAML_BATCH_SCAN=0`,
+  `EXAML_BATCH_THOROUGH=0`) — the one tier hardware-proven everywhere;
+* treats a child exit of EXIT_PREEMPTED (75) as RESUMABLE: restarted
+  immediately, no retry consumed (capped separately so a preemption
+  storm still terminates);
+* forwards its own SIGTERM/SIGINT to the child as SIGTERM, so
+  preempting the supervisor preempts the run gracefully end-to-end;
+* merges its `resilience.*` counters into the child's `--metrics`
+  snapshot, so one artifact carries both sides' evidence
+  (`resilience.restarts`, `resilience.heartbeat_stalls`,
+  `resilience.preempts`, plus the child's `engine.nonfinite_retries`).
+
+`EXAML_RESTART_COUNT` is exported to each attempt so fault-injection
+specs (`resilience/faults.py`) can target a single attempt — the
+mechanism that makes "crash once, then recover" chaos tests converge.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import Dict, List, Optional
+
+from examl_tpu.resilience import exitcause, heartbeat
+
+# Degradation ladder, in escalation order (mirrors ops/bank.FALLBACK_ENV
+# without importing it: bank pulls in obs/jax, this parent must not).
+DEGRADE_LADDER = (
+    {},
+    {"EXAML_PALLAS": "0"},
+    {"EXAML_PALLAS": "0", "EXAML_FAST_TRAVERSAL": "0",
+     "EXAML_BATCH_SCAN": "0", "EXAML_BATCH_THOROUGH": "0"},
+)
+
+DEFAULT_RETRIES = 3
+DEFAULT_STALL = 300.0
+POLL_S = 0.25
+
+# Supervisor flags stripped from the child's argv.  Values live with the
+# flag (argparse two-token form) — single-token "--flag=value" is also
+# handled by prefix match.
+_SUPERVISOR_FLAGS = {"--supervise": 0, "--supervise-retries": 1,
+                     "--supervise-stall": 1, "--supervise-backoff": 1}
+
+
+def child_argv(argv: List[str]) -> List[str]:
+    """The supervised child's argument list: the original CLI argv minus
+    the supervisor-only flags (`--inject-fault` passes THROUGH — the
+    child arms the registry; attempt gating keeps retries clean)."""
+    out: List[str] = []
+    skip = 0
+    for tok in argv:
+        if skip:
+            skip -= 1
+            continue
+        flag = tok.split("=", 1)[0]
+        if flag in _SUPERVISOR_FLAGS:
+            if "=" not in tok:
+                skip = _SUPERVISOR_FLAGS[flag]
+            continue
+        out.append(tok)
+    return out
+
+
+def checkpoint_glob(workdir: str, run_id: str) -> List[str]:
+    """Checkpoint files for (workdir, run_id) — the same naming
+    CheckpointManager publishes (search/checkpoint.py; that module
+    imports jax via the instance, so the pattern is mirrored here and
+    pinned by a cross-check test)."""
+    return sorted(glob.glob(os.path.join(
+        workdir, f"ExaML_binaryCheckpoint.{run_id}.ckpt_*.json.gz")))
+
+
+def _repo_env() -> Dict[str, str]:
+    env = dict(os.environ)
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    pp = [p for p in env.get("PYTHONPATH", "").split(os.pathsep) if p]
+    if repo not in pp:
+        env["PYTHONPATH"] = os.pathsep.join([repo] + pp)
+    return env
+
+
+class Supervisor:
+    def __init__(self, argv: List[str], workdir: str, run_id: str,
+                 max_retries: int = DEFAULT_RETRIES,
+                 stall_timeout: float = DEFAULT_STALL,
+                 backoff: float = 2.0,
+                 metrics_file: Optional[str] = None,
+                 log=print):
+        self.base_argv = child_argv(argv)
+        self.workdir = workdir
+        self.run_id = run_id
+        self.max_retries = max_retries
+        self.stall_timeout = stall_timeout
+        self.backoff = backoff
+        self.metrics_file = metrics_file
+        self.log = lambda msg: log(f"supervise: {msg}")
+        os.makedirs(workdir, exist_ok=True)
+        self.hb_path = os.path.join(workdir,
+                                    f".heartbeat.{run_id}.json")
+        # Counters mirrored into the metrics snapshot at the end — the
+        # supervisor is jax/obs-free, so it keeps its own dict.
+        self.counters: Dict[str, float] = {}
+        self.attempts: List[dict] = []
+        self.degrade_level = 0
+        self._preempt_signal: Optional[str] = None
+        self._child: Optional[subprocess.Popen] = None
+        self._last_argv: List[str] = []
+
+    # -- bookkeeping --------------------------------------------------------
+
+    def _inc(self, name: str, v: float = 1) -> None:
+        self.counters[name] = self.counters.get(name, 0) + v
+
+    def _pins(self) -> Dict[str, str]:
+        return dict(DEGRADE_LADDER[min(self.degrade_level,
+                                       len(DEGRADE_LADDER) - 1)])
+
+    def _attempt_argv(self) -> List[str]:
+        argv = list(self.base_argv)
+        if "-R" not in argv and checkpoint_glob(self.workdir, self.run_id):
+            argv.append("-R")
+        return argv
+
+    # -- signal forwarding --------------------------------------------------
+
+    def _install_signals(self):
+        if not hasattr(signal, "SIGTERM"):
+            return None
+
+        def handler(signum, frame):
+            self._preempt_signal = signal.Signals(signum).name
+            child = self._child
+            if child is not None and child.poll() is None:
+                try:                        # graceful: the child
+                    os.killpg(child.pid, signal.SIGTERM)  # checkpoints
+                except (OSError, ProcessLookupError):
+                    pass
+
+        try:
+            return (signal.signal(signal.SIGTERM, handler),
+                    signal.signal(signal.SIGINT, handler))
+        except ValueError:                  # non-main thread (tests)
+            return None
+
+    def _restore_signals(self, prior) -> None:
+        if prior is not None:
+            signal.signal(signal.SIGTERM, prior[0])
+            signal.signal(signal.SIGINT, prior[1])
+
+    # -- one attempt --------------------------------------------------------
+
+    def _spawn(self, restarts_total: int) -> subprocess.Popen:
+        env = _repo_env()
+        env["EXAML_HEARTBEAT_FILE"] = self.hb_path
+        env["EXAML_RESTART_COUNT"] = str(restarts_total)
+        env.update(self._pins())
+        argv = self._last_argv = self._attempt_argv()
+        pins = self._pins()
+        self.log(f"attempt {restarts_total}: starting "
+                 + ("(resume -R) " if "-R" in argv else "")
+                 + (f"[pins {pins}] " if pins else "")
+                 + " ".join(argv))
+        try:
+            os.unlink(self.hb_path)         # stale beats must not mask
+        except OSError:                     # a child that never starts
+            pass
+        return subprocess.Popen(
+            [sys.executable, "-m", "examl_tpu.cli.main"] + argv,
+            env=env, start_new_session=True)
+
+    def _kill_group(self, child: subprocess.Popen) -> None:
+        """SIGKILL the child's whole process group: bank workers and any
+        other helpers must die with it, or the retry races them for the
+        accelerator."""
+        for target in (child.pid,):
+            try:
+                os.killpg(target, signal.SIGKILL)
+            except (OSError, ProcessLookupError):
+                try:
+                    child.kill()
+                except OSError:
+                    pass
+        child.wait()
+
+    def _watch(self, child: subprocess.Popen) -> str:
+        """Wait for exit or heartbeat stall; returns the exit cause."""
+        spawned = time.time()
+        # Startup (data load, banking, first compiles, the pre-search
+        # model opt) legitimately produces no beats, so the deadline
+        # for the FIRST beat is much more generous than the stall
+        # window — but it must exist: a dispatch that wedges before the
+        # first search iteration would otherwise hang the supervisor
+        # forever.
+        first_beat_deadline = max(4.0 * self.stall_timeout, 900.0)
+        while True:
+            rc = child.poll()
+            if rc is not None:
+                return exitcause.classify(rc)
+            if self.stall_timeout:
+                hb_age = heartbeat.age(self.hb_path)
+                stalled = (hb_age > self.stall_timeout
+                           if hb_age is not None else
+                           time.time() - spawned > first_beat_deadline)
+                if stalled:
+                    # The search loop stopped beating (or never
+                    # started): dispatch/collective wedge.  Kill the
+                    # whole group and classify ourselves — our SIGKILL
+                    # must not read as an OOM kill.
+                    last = heartbeat.read(self.hb_path) or {}
+                    self.log(
+                        "heartbeat stalled ("
+                        + (f"{hb_age:.0f}s > {self.stall_timeout:.0f}s"
+                           if hb_age is not None else
+                           f"no first beat within {first_beat_deadline:.0f}s")
+                        + f"; last state {last.get('state')!r} seq "
+                        f"{last.get('seq')}); killing the child process "
+                        "group")
+                    self._inc("resilience.heartbeat_stalls")
+                    self._kill_group(child)
+                    return exitcause.CAUSE_HANG_KILL
+            time.sleep(POLL_S)
+
+    # -- the supervision loop -----------------------------------------------
+
+    def run(self) -> int:
+        prior = self._install_signals()
+        retries = 0
+        preempts = 0
+        restarts_total = 0
+        rc = 1
+        try:
+            while True:
+                if self._preempt_signal is not None:
+                    # Preempted BETWEEN children (during the backoff
+                    # sleep or before the first spawn): there is no
+                    # child to forward to — exit resumable now instead
+                    # of launching an attempt the grace window will
+                    # just SIGKILL.
+                    self.log(f"supervisor preempted "
+                             f"({self._preempt_signal}) between "
+                             "attempts; not restarting")
+                    self._inc("resilience.preempts")
+                    return exitcause.EXIT_PREEMPTED
+                t0 = time.time()
+                self._child = child = self._spawn(restarts_total)
+                cause = self._watch(child)
+                self._child = None
+                rc = child.returncode
+                self.attempts.append({
+                    "attempt": restarts_total, "cause": cause,
+                    "returncode": rc, "seconds": round(time.time() - t0, 2),
+                    "pins": self._pins(),
+                    "resumed": "-R" in self._last_argv})
+                desc = exitcause.exit_desc(rc, none_desc="(hang-killed)")
+
+                if cause == exitcause.CAUSE_OK:
+                    self.log(f"run completed after {restarts_total} "
+                             "restart(s)")
+                    return 0
+                if self._preempt_signal is not None:
+                    # WE were preempted: the child checkpointed (or
+                    # died); do not restart — exit resumable ourselves.
+                    self.log(f"supervisor preempted ({self._preempt_signal})"
+                             f"; child exited {desc}; not restarting")
+                    self._inc("resilience.preempts")
+                    return exitcause.EXIT_PREEMPTED
+                if cause == exitcause.CAUSE_PREEMPT:
+                    # The CHILD was preempted externally but we were
+                    # not: resume immediately, no retry consumed.
+                    preempts += 1
+                    self._inc("resilience.preempts")
+                    if preempts > max(10, 5 * self.max_retries):
+                        self.log("preemption storm: giving up")
+                        return exitcause.EXIT_PREEMPTED
+                    restarts_total += 1
+                    self._inc("resilience.restarts")
+                    self.log(f"child preempted {desc}; resuming "
+                             "(no retry consumed)")
+                    continue
+                if cause == exitcause.CAUSE_USAGE:
+                    self.log(f"usage error {desc}: not retryable")
+                    return rc
+                # Failure: classify, maybe degrade, retry with backoff.
+                retries += 1
+                self._inc("resilience.restarts")
+                self._inc(f"resilience.exits.{cause.replace('-', '_')}")
+                if retries > self.max_retries:
+                    self.log(f"child failed ({cause} {desc}); retry "
+                             f"budget exhausted after {self.max_retries}")
+                    # Signal deaths surface as the conventional
+                    # 128+signum (a raw negative rc through sys.exit
+                    # becomes an unclassifiable 247-style status).
+                    if rc is None:
+                        return 1
+                    return 128 - rc if rc < 0 else (rc or 1)
+                if cause in exitcause.TIER_SUSPECT:
+                    self.degrade_level = min(self.degrade_level + 1,
+                                             len(DEGRADE_LADDER) - 1)
+                delay = min(60.0, self.backoff * (2 ** (retries - 1)))
+                have_ckpt = bool(checkpoint_glob(self.workdir,
+                                                 self.run_id))
+                self.log(
+                    f"child failed ({cause} {desc}); retry "
+                    f"{retries}/{self.max_retries} in {delay:.1f}s "
+                    + ("from newest checkpoint"
+                       if have_ckpt else "from scratch (no checkpoint)")
+                    + (f", degradation level {self.degrade_level} "
+                       f"pins {self._pins()}"
+                       if self._pins() else ""))
+                time.sleep(delay)
+                restarts_total += 1
+        finally:
+            child = self._child
+            if child is not None and child.poll() is None:
+                self._kill_group(child)
+            self._restore_signals(prior)
+            self._merge_metrics()
+
+    # -- metrics ------------------------------------------------------------
+
+    def _merge_metrics(self) -> None:
+        """Fold the supervisor's evidence into the child's --metrics
+        snapshot (the child rewrites the file at every exit, so the
+        LAST attempt's registry is on disk; the supervisor's counters
+        span all attempts).  Without --metrics, write nothing — the log
+        lines remain the record."""
+        if not self.metrics_file:
+            return
+        snap: dict = {}
+        try:
+            with open(self.metrics_file) as f:
+                snap = json.load(f)
+        except (OSError, ValueError):
+            snap = {}
+        snap.setdefault("counters", {}).update(self.counters)
+        snap.setdefault("gauges", {})["resilience.degrade_level"] = \
+            self.degrade_level
+        snap["resilience"] = {"attempts": self.attempts,
+                              "final_pins": self._pins(),
+                              "heartbeat_file": self.hb_path}
+        try:
+            with open(self.metrics_file, "w") as f:
+                json.dump(snap, f, indent=2, sort_keys=True, default=str)
+            self.log(f"metrics snapshot (merged) -> {self.metrics_file}")
+        except OSError as exc:
+            self.log(f"metrics merge failed ({exc})")
+
+
+def supervise(argv: List[str], args, log=print) -> int:
+    """CLI entry: run `argv` (the full original command line) under
+    supervision.  `args` is the parsed namespace — only supervisor and
+    file-placement flags are read; everything jax-flavored happens in
+    the child."""
+    workdir = getattr(args, "workdir", ".") or "."
+    sup = Supervisor(
+        argv, workdir=workdir, run_id=args.run_id,
+        max_retries=getattr(args, "supervise_retries", DEFAULT_RETRIES),
+        stall_timeout=getattr(args, "supervise_stall", DEFAULT_STALL),
+        backoff=getattr(args, "supervise_backoff", 2.0),
+        metrics_file=getattr(args, "metrics_file", None),
+        log=log)
+    return sup.run()

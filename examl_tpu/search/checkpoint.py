@@ -32,6 +32,12 @@ CKPT_VERSION = 1
 CKPT_MAGIC = "examl-tpu-checkpoint"
 
 
+class CorruptCheckpoint(ValueError):
+    """A checkpoint file that cannot be parsed (truncated/corrupt gzip,
+    invalid JSON, missing magic or required sections) — the restore
+    fallback skips these; genuine config mismatches raise ValueError."""
+
+
 def _fingerprint(inst: PhyloInstance) -> dict:
     """Alignment/flag identity that must match between run and restart."""
     al = inst.alignment
@@ -183,9 +189,43 @@ class CheckpointManager:
         }
         path = self.path_for(self.counter)
         tmp = path + ".tmp"
-        with gzip.open(tmp, "wt") as f:
-            json.dump(blob, f)
-        os.replace(tmp, path)       # atomic publish; never overwrite older
+        from examl_tpu.resilience import faults
+        try:
+            with gzip.open(tmp, "wt") as f:
+                json.dump(blob, f)
+            # fsync the CLOSED tmp (the gzip trailer — final deflate
+            # block + CRC/ISIZE — is only written at close) BEFORE the
+            # rename, and fsync the DIRECTORY after: os.replace alone
+            # is only atomic against concurrent readers — after a hard
+            # kill or power loss an un-fsynced "published" checkpoint
+            # can come back truncated or as a dangling directory entry,
+            # which is exactly the artifact the restore fallback exists
+            # to route around; the write side must not manufacture it.
+            fd = os.open(tmp, os.O_RDONLY)
+            try:
+                os.fsync(fd)
+            finally:
+                os.close(fd)
+            # Fault seam: `checkpoint.write` fires between the tmp
+            # write and the publish — a raise (default) models a full
+            # disk / I/O error, `:signal=KILL` models dying mid-write:
+            # either way the previously PUBLISHED checkpoint is intact.
+            faults.fire("checkpoint.write")
+            os.replace(tmp, path)   # atomic publish; never overwrite older
+        except OSError:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+        try:                        # directory-entry durability: best
+            dirfd = os.open(self.workdir, os.O_RDONLY)  # effort on
+            try:                    # filesystems that reject dir fsync
+                os.fsync(dirfd)
+            finally:
+                os.close(dirfd)
+        except OSError:
+            pass
         self.counter += 1
         self._prune()
         return path
@@ -219,18 +259,58 @@ class CheckpointManager:
 
     def restore(self, inst: PhyloInstance, tree: Tree,
                 path: Optional[str] = None) -> Optional[dict]:
-        """Load the newest (or given) checkpoint into inst+tree; returns the
-        resume blob for compute_big_rapid, or None if no checkpoint exists.
+        """Load the newest readable checkpoint into inst+tree; returns
+        the resume blob for compute_big_rapid, or None if no (intact)
+        checkpoint exists.
+
+        A checkpoint that fails to PARSE — truncated/corrupt gzip,
+        invalid JSON, wrong magic — is skipped with a logged warning
+        and the next-newest numbered file is tried: a kill or power
+        loss at exactly the wrong moment must cost one checkpoint
+        interval, not every restart attempt forever.  An explicit
+        `path` disables the fallback (the caller asked for THAT file).
 
         Raises ValueError on an incompatible run configuration (the
-        reference aborts on mismatched restart flags)."""
-        path = path or self.latest_path()
-        if path is None:
-            return None
-        with gzip.open(path, "rt") as f:
-            blob = json.load(f)
-        if blob.get("magic") != CKPT_MAGIC:
-            raise ValueError(f"not an examl-tpu checkpoint: {path}")
+        reference aborts on mismatched restart flags) — configuration
+        mismatch is operator error, not corruption, and silently
+        resuming an older file would hide it."""
+        if path is not None:
+            return self._restore_one(inst, tree, path)
+        from examl_tpu import obs
+        nums = sorted(
+            (int(m.group(1)) for f in glob.glob(self._pattern())
+             if (m := self.FILE_RE.search(f))), reverse=True)
+        for n in nums:
+            p = self.path_for(n)
+            try:
+                return self._restore_one(inst, tree, p)
+            except CorruptCheckpoint as exc:
+                obs.inc("checkpoint.corrupt_skipped")
+                obs.log(f"EXAML: checkpoint {p} unreadable ({exc}); "
+                        "falling back to the next-newest checkpoint")
+        if nums:
+            obs.log(f"EXAML: all {len(nums)} checkpoint(s) for run "
+                    f"'{self.run_id}' are unreadable; nothing to resume")
+        return None
+
+    def _restore_one(self, inst: PhyloInstance, tree: Tree,
+                     path: str) -> dict:
+        try:
+            with gzip.open(path, "rt") as f:
+                blob = json.load(f)
+        except (OSError, EOFError, ValueError, gzip.BadGzipFile) as exc:
+            # EOFError/BadGzipFile: truncated/garbage gzip stream (the
+            # partial-write-at-kill-time artifact); ValueError covers
+            # json.JSONDecodeError and bad gzip headers.
+            raise CorruptCheckpoint(f"{type(exc).__name__}: {exc}") \
+                from exc
+        if not isinstance(blob, dict) or blob.get("magic") != CKPT_MAGIC:
+            raise CorruptCheckpoint(f"not an examl-tpu checkpoint: {path}")
+        missing = [k for k in ("fingerprint", "models", "tree", "state")
+                   if k not in blob]
+        if missing:
+            raise CorruptCheckpoint(
+                f"checkpoint missing section(s) {missing}: {path}")
         if blob.get("version") != CKPT_VERSION:
             raise ValueError(f"checkpoint version {blob.get('version')} "
                              f"unsupported")

@@ -15,6 +15,7 @@ from typing import Callable, List, Optional
 
 from examl_tpu import obs
 from examl_tpu.constants import UNLIKELY
+from examl_tpu.resilience import heartbeat
 from examl_tpu.instance import PhyloInstance
 from examl_tpu.optimize.branch import tree_evaluate
 from examl_tpu.optimize.model_opt import mod_opt
@@ -94,6 +95,11 @@ def _tree_optimize_rapid(inst: PhyloInstance, tree: Tree, ctx: SprContext,
         ctx.lh_dec = 0
 
     for p in slots:
+        # Liveness beat per SPR slot: every beat proves the previous
+        # slot's dispatches returned — a wedged dispatch/collective
+        # freezes this clock and the supervisor acts (the compile
+        # watchdog cannot see post-compile wedges).
+        heartbeat.beat("SPR_THOROUGH" if ctx.thorough else "SPR_LAZY")
         ctx.best_of_node = UNLIKELY
         if not rearrange(inst, tree, ctx, p, mintrav, maxtrav):
             continue
@@ -116,6 +122,7 @@ def _tree_optimize_rapid(inst: PhyloInstance, tree: Tree, ctx: SprContext,
         # Thorough re-pass over the best lazy-insertion origins (iList).
         ctx.thorough = True
         for p in ilist.active_nodes():
+            heartbeat.beat("SPR_REPASS")
             ctx.best_of_node = UNLIKELY
             if not rearrange(inst, tree, ctx, p, mintrav, maxtrav):
                 continue
@@ -163,6 +170,7 @@ def _determine_rearrangement_setting(inst, tree, ctx, opts, best_t, bt,
         maxtrav = min(maxtrav, tree.ntips - 3)
         ctx.start_lh = ctx.end_lh = inst.likelihood
         for p in dfs_slot_order(tree):
+            heartbeat.beat("REARR_SETTING")
             ctx.best_of_node = UNLIKELY
             if rearrange(inst, tree, ctx, p, 1, maxtrav):
                 if ctx.end_lh > ctx.start_lh:
@@ -315,6 +323,7 @@ def compute_big_rapid(inst: PhyloInstance, tree: Tree,
 
             fast_iterations += 1
             obs.inc("search.fast_cycles")
+            heartbeat.beat("FAST_SPRS")
             tree_evaluate(inst, tree, 1.0)
             best_t.save(tree, inst.likelihood)
             opts.log(f"fast cycle {fast_iterations} start "
@@ -367,6 +376,7 @@ def compute_big_rapid(inst: PhyloInstance, tree: Tree,
                     break
             thorough_iterations += 1
             obs.inc("search.thorough_cycles")
+            heartbeat.beat("SLOW_SPRS")
         else:
             rearr_max += opts.stepwidth
             rearr_min += opts.stepwidth
