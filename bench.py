@@ -344,6 +344,27 @@ def _bytes_per_traversal(entries, ntips: int, patterns: int, R: int,
     return total
 
 
+def _host_schedule_total() -> float:
+    """Accumulated host-schedule seconds from the obs registry (the
+    `host_schedule` timer every schedule builder observes into)."""
+    from examl_tpu import obs
+    snap = obs.registry().snapshot()
+    return float(snap.get("timers", {})
+                 .get("host_schedule", {}).get("total_s") or 0.0)
+
+
+def _peak_rss_mb():
+    """Process peak RSS in MB; None off-POSIX.  ru_maxrss is KB on
+    linux but BYTES on macOS."""
+    try:
+        import resource
+        div = 1024.0 * 1024.0 if sys.platform == "darwin" else 1024.0
+        return round(resource.getrusage(
+            resource.RUSAGE_SELF).ru_maxrss / div, 1)
+    except Exception:                            # noqa: BLE001
+        return None
+
+
 def _measure_variant(inst, tree, eng, entries, variant) -> dict:
     import jax
 
@@ -356,6 +377,7 @@ def _measure_variant(inst, tree, eng, entries, variant) -> dict:
     # engine's own tier decision so later stages (prims) measure the
     # production path, not whichever variant was timed last.
     tier = (eng.use_pallas, eng.pallas_whole)
+    sched0 = _host_schedule_total()
     try:
         fn = _chained(_variant_step(eng, variant, entries), n_steps)
         buf = eng._state()[0] if eng.save_memory else eng.clv
@@ -381,6 +403,13 @@ def _measure_variant(inst, tree, eng, entries, variant) -> dict:
         "dtype": str(np.dtype(eng.dtype)),
         "gbps": round(n_steps * bytes_per / dt / 1e9, 2),
         "backend": jax.default_backend(),
+        # Host floor vs device throughput (ROOFLINE.md "host floor"):
+        # seconds this stage spent building schedules on the host (obs
+        # `host_schedule` timer delta) and the worker's peak RSS at
+        # stage end (ru_maxrss is monotone per process, so per-stage
+        # values bound each stage's true peak from above).
+        "host_schedule_s": round(_host_schedule_total() - sched0, 4),
+        "peak_rss_mb": _peak_rss_mb(),
     }
     if flops is not None:
         fps = flops / dt
@@ -536,6 +565,7 @@ def _stage_prims(state: _WorkerState) -> dict:
 
     inst, tree, eng, entries, dataset, lnl = state.small_state()
     out = {}
+    sched0 = _host_schedule_total()
     inner = [tree.nodep[n] for n in tree.inner_numbers()
              if not tree.is_tip(tree.nodep[n].back.number)][:12]
     for p in inner:     # warm compile variants
@@ -571,6 +601,8 @@ def _stage_prims(state: _WorkerState) -> dict:
     hookup(p.next, q1, p1z)
     hookup(p.next.next, q2, p2z)
     inst.new_view(tree, p)
+    out["host_schedule_s"] = round(_host_schedule_total() - sched0, 4)
+    out["peak_rss_mb"] = _peak_rss_mb()
     return out
 
 

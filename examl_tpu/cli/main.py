@@ -725,6 +725,12 @@ def _run(args, files: RunFiles) -> int:
                     f"{local_window[1]} loads only its site blocks")
             elif nprocs > 1:
                 files.info(f"whole-file reads per process ({reason})")
+        # Setup-phase liveness (PARSE/PACK, plus SCHEDULE beats from the
+        # traversal builders): large-tree host phases are minutes of
+        # legitimate silence the --supervise stall detector must not
+        # hang-kill — until now it only saw beats from the search loop.
+        from examl_tpu.resilience import heartbeat as _hb
+        _hb.phase_beat("PARSE")
         data = _load_alignment(
             args.bytefile, local_window=local_window,
             block_multiple=(sharding.num_devices if sharding else 1))
@@ -732,6 +738,7 @@ def _run(args, files: RunFiles) -> int:
                    + (" (this process)" if local_window else "")
                    + f", {len(data.partitions)} partitions")
 
+        _hb.phase_beat("PACK")
         inst = PhyloInstance(
             data, ncat=4, use_median=args.median,
             per_partition_branches=args.per_partition_bl,

@@ -245,12 +245,21 @@ class PhyloInstance:
 
     def run_traversal(self, entries: List[TraversalEntry],
                       only_states=None, full: bool = False) -> None:
-        if not entries:
+        if not len(entries):
             return
         for states, eng in self.engines.items():
             if only_states is not None and states not in only_states:
                 continue
             eng.run_traversal(entries, full=full)
+
+    def invalidate_schedules(self) -> None:
+        """Drop every engine's cached schedule structures.  Called from
+        the search's topology-commit seams (SPR regraft, best-tree
+        recall, checkpoint restore); the signature keys already make
+        staleness impossible, so this is hygiene + obs evidence
+        (engine.sched_cache.invalidate)."""
+        for eng in self.engines.values():
+            eng.sched_cache_invalidate()
 
     # -- likelihood --------------------------------------------------------
 
@@ -278,8 +287,17 @@ class PhyloInstance:
             p = tree.centroid_branch() if full else tree.start
         q = p.back
         if full:
-            tree.invalidate_all()
-        entries = self._collect(tree, p, full) + self._collect(tree, q, full)
+            # Array-rate full traversal (tree/topology.py): one host
+            # pass + numpy scheduling, carrying the topology signature
+            # the engines' schedule-structure caches key on.  Subsumes
+            # invalidate_all + the two compute_traversal calls (every
+            # inner node recomputed and re-oriented toward this edge).
+            from examl_tpu import obs
+            with obs.timer("host_schedule"):
+                entries = tree.flat_full_traversal(p)
+        else:
+            entries = (self._collect(tree, p, full)
+                       + self._collect(tree, q, full))
         per_part = self.per_partition_lnl
         from examl_tpu.resilience import faults
         faults.fire("engine.dispatch")

@@ -12,9 +12,18 @@ Naming convention (dotted, lowercase):
   engine.dispatch_count        device program invocations
   engine.traversal_entries     newview entries submitted (retraversal size)
   engine.cache_hits/misses/evictions   shared fast-program LRU
+  engine.sched_cache.hit/miss          topology-keyed schedule-structure
+  engine.sched_cache.invalidate/evictions   cache (ops/engine.py)
+  host_schedule                timer: host-side schedule building
+                               (flat traversal + structure/z assembly,
+                               scan-tier packing) — the host floor,
+                               split from device dispatch
   engine.compile_count, engine.compile_seconds[.family]
   engine.compile_count.bank_phase      first calls inside the bank phase
-  engine.first_calls.banked/unbanked   post-bank first calls by verdict
+  engine.first_calls.banked/unbanked[.family]   post-bank first calls
+  engine.first_calls.degraded_inprocess[.family]   deadline-degraded
+                               scan-tier family compiled in-process
+                               (watchdogged; expected, not a gap)
   engine.pallas_fallbacks      Mosaic -> XLA demotions
   engine.watchdog_barks        compile-deadline watchdog firings
   engine.nonfinite_retries/.nonfinite_recovered   NaN-lnL scan-tier retries

@@ -27,7 +27,7 @@ from examl_tpu.models.gtr import ModelParams
 from examl_tpu.ops import kernels
 from examl_tpu.ops.kernels import DeviceModels, Traversal
 from examl_tpu.parallel.packing import PackedBucket
-from examl_tpu.tree.topology import TraversalEntry
+from examl_tpu.tree.topology import FlatTraversal, TraversalEntry
 from examl_tpu.utils import z_slots as _z_slots
 
 
@@ -164,6 +164,21 @@ class LikelihoodEngine:
         from collections import OrderedDict
         self._fast_jit_cache = OrderedDict()
         self._fast_jit_cache_cap = 32
+        # Schedule-STRUCTURE cache (tentpole of the host-path scale
+        # work): the immutable half of a fast-path schedule — chunk
+        # layout, child index/code arrays, row map — keyed by the
+        # traversal's 128-bit topology signature (FlatTraversal.
+        # topo_key, a function of topology + root edge only).  The
+        # branch-length-only full traversals that dominate model
+        # optimization and repeated evaluations hit here and skip the
+        # Python schedule rebuild entirely, refreshing only z
+        # (fastpath.refresh_z).  Self-validating: an SPR/NNI topology
+        # change mints a different signature, so a stale structure can
+        # never be served — explicit invalidation (sched_cache_
+        # invalidate, called from the search's commit seams) is memory
+        # hygiene plus the obs evidence, not a correctness requirement.
+        self._sched_cache = OrderedDict()
+        self._sched_cache_cap = 8
         self.sharding = sharding
         self.pallas_interpret = _pos.environ.get(
             "EXAML_PALLAS_INTERPRET", "") == "1"
@@ -592,8 +607,9 @@ class LikelihoodEngine:
                          zr=jnp.asarray(zr, dtype=self.dtype))
 
     def _traversal_arrays(self, entries: List[TraversalEntry]) -> Traversal:
-        return self._pack_traversal(
-            entries, lambda e: self.row_map[e.parent], self._gidx)
+        with obs.timer("host_schedule"):
+            return self._pack_traversal(
+                entries, lambda e: self.row_map[e.parent], self._gidx)
 
     def _gidx(self, num: int) -> int:
         """gather_child index of a node: tips by code slot, inner nodes by
@@ -643,13 +659,30 @@ class LikelihoodEngine:
 
     def run_traversal(self, entries: List[TraversalEntry],
                       full: bool = False) -> None:
-        if not entries:
+        """Recompute CLVs for `entries` — a TraversalEntry list, or (for
+        full traversals) a `FlatTraversal`, which takes the cached-
+        structure fast path and falls back to the legacy list form for
+        the scan/PSR/SEV tiers."""
+        if not len(entries):
             return
         obs.inc("engine.dispatch_count")
         obs.inc("engine.traversal_entries", len(entries))
+        flat = entries if isinstance(entries, FlatTraversal) else None
         with obs.device_span("engine:traverse",
                              args={"entries": len(entries),
                                    "full": bool(full)}):
+            if flat is not None:
+                if full and self._fast_eligible_flat(flat):
+                    try:
+                        self._run_fast_flat(flat)
+                        self._pallas_proven = self.use_pallas
+                    except Exception as exc:   # Mosaic lowering/compile
+                        if not self.use_pallas or self._pallas_proven:
+                            raise
+                        self._pallas_failed(exc)
+                        self._run_fast_flat(flat)
+                    return
+                entries = flat.to_entries()
             if full and self._fast_eligible(entries):
                 try:
                     self._run_fast_traversal(entries)
@@ -758,10 +791,24 @@ class LikelihoodEngine:
                     # a new shape variant is expected (persistent-cache
                     # hit); an UNBANKED first call means the bank's
                     # enumeration missed a family — the acceptance
-                    # counter for wedge immunity.
-                    obs.inc("engine.first_calls.banked"
-                            if bank.is_banked(family)
-                            else "engine.first_calls.unbanked")
+                    # counter for wedge immunity.  A family the bank
+                    # ATTEMPTED but had to degrade is a separate case:
+                    # scan-tier families have no escape hatch ("no
+                    # fallback exists for the fallback tier itself"),
+                    # so when their worker loses the compile deadline
+                    # on a loaded host the run legitimately compiles
+                    # them in-process — that is the watchdogged path
+                    # the bank's own log promises, not an enumeration
+                    # gap, and it must not trip the acceptance counter.
+                    if bank.is_banked(family):
+                        obs.inc("engine.first_calls.banked")
+                    elif family in bank.degraded():
+                        obs.inc("engine.first_calls.degraded_inprocess")
+                        obs.inc("engine.first_calls."
+                                f"degraded_inprocess.{family}")
+                    else:
+                        obs.inc("engine.first_calls.unbanked")
+                        obs.inc(f"engine.first_calls.unbanked.{family}")
 
         return call
 
@@ -867,16 +914,127 @@ class LikelihoodEngine:
 
     def _fast_schedule(self, entries: List[TraversalEntry]):
         from examl_tpu.ops import fastpath
-        sched = fastpath.build_schedule(entries, self.ntips,
-                                        self.num_branch_slots, self.dtype)
+        with obs.timer("host_schedule"):
+            sched = fastpath.build_schedule(entries, self.ntips,
+                                            self.num_branch_slots,
+                                            self.dtype)
         assert sched.max_write <= self.num_rows - 1, \
             (sched.max_write, self.num_rows)
         return sched
 
     def _install_row_map(self, sched) -> None:
-        self.row_map[:] = -1
-        for num, row in sched.row_of.items():
-            self.row_map[num] = row
+        ro = sched.row_of
+        if isinstance(ro, dict):
+            self.row_map[:] = -1
+            for num, row in ro.items():
+                self.row_map[num] = row
+        else:                       # FastStructure: vectorized array copy
+            self.row_map[:ro.shape[0]] = ro
+
+    # -- cached schedule structures (flat fast path) -------------------------
+
+    def sched_cache_invalidate(self) -> None:
+        """Drop cached schedule structures (search commit seams call
+        this through instance.invalidate_schedules after an SPR/NNI
+        topology change or a checkpoint restore).  Purely hygiene +
+        evidence: the topology-signature keys already guarantee a stale
+        structure can never be served."""
+        if self._sched_cache:
+            obs.inc("engine.sched_cache.invalidate")
+            self._sched_cache.clear()
+
+    def _fast_structure(self, flat):
+        from examl_tpu.ops import fastpath
+        st = self._sched_cache.get(flat.topo_key)
+        if st is not None:
+            self._sched_cache.move_to_end(flat.topo_key)
+            obs.inc("engine.sched_cache.hit")
+            return st
+        obs.inc("engine.sched_cache.miss")
+        st = fastpath.build_structure(flat, self.ntips)
+        assert st.max_write <= self.num_rows - 1, \
+            (st.max_write, self.num_rows)
+        self._sched_cache[flat.topo_key] = st
+        while len(self._sched_cache) > self._sched_cache_cap:
+            self._sched_cache.popitem(last=False)
+            obs.inc("engine.sched_cache.evictions")
+        return st
+
+    def _fast_eligible_flat(self, flat) -> bool:
+        return (not self.psr and not self.force_scan
+                and self.fast_slack > 0 and flat.n == self.n_inner)
+
+    def _fast_fn_flat(self, profile, with_eval: bool):
+        """Jitted chunk program over PACKED structure + z arrays: each
+        chunk's window is sliced statically from the profile inside the
+        trace, so a dispatch carries 7 array leaves total instead of 7
+        per chunk.  Key leads with "fast" — same program family as the
+        legacy chunk path for the bank/watchdog accounting."""
+        key = ("fast", profile, "flat", with_eval)
+        fn = self.cache_get(key)
+        if fn is not None:
+            return fn
+        from examl_tpu.ops import fastpath
+
+        def build_chunks(base, lidx, ridx, lcode, rcode, zl, zr):
+            chunks = []
+            off = 0
+            for ci, (kind, W) in enumerate(profile):
+                sl = lambda a: jax.lax.slice_in_dim(a, off, off + W)
+                chunks.append(fastpath.FastChunk(
+                    kind, W, base[ci], sl(lidx), sl(ridx), sl(lcode),
+                    sl(rcode), sl(zl), sl(zr)))
+                off += W
+            return chunks
+
+        def impl(clv, scaler, base, lidx, ridx, lcode, rcode, zl, zr,
+                 dm, block_part, tips):
+            chunks = build_chunks(base, lidx, ridx, lcode, rcode, zl, zr)
+            return self._run_chunks_impl(dm, block_part, tips, clv,
+                                         scaler, chunks)
+
+        def impl_eval(clv, scaler, base, lidx, ridx, lcode, rcode, zl,
+                      zr, p_idx, q_idx, z, dm, block_part, weights,
+                      tips):
+            chunks = build_chunks(base, lidx, ridx, lcode, rcode, zl, zr)
+            clv, scaler = self._run_chunks_impl(dm, block_part, tips,
+                                                clv, scaler, chunks)
+            lnl = kernels.root_log_likelihood(
+                dm, block_part, weights, tips, clv, scaler, p_idx, q_idx,
+                z, self.num_parts, self.scale_exp, self.ntips, None)
+            return clv, scaler, lnl
+
+        return self.cache_put(key, jax.jit(
+            impl_eval if with_eval else impl, donate_argnums=(0, 1)))
+
+    def _run_fast_flat(self, flat, p_num=None, q_num=None, z=None):
+        """Fast full traversal (and optional fused root evaluation) from
+        a FlatTraversal: cached structure + fresh z only."""
+        from examl_tpu.ops import fastpath
+        if self.pallas_whole:
+            return self._run_whole(flat.to_entries(), p_num, q_num, z)
+        with obs.timer("host_schedule"):
+            st = self._fast_structure(flat)
+            zl, zr = fastpath.refresh_z(st, flat, self.num_branch_slots,
+                                        self.dtype)
+        if p_num is None:
+            fn = self._fast_fn_flat(st.profile, with_eval=False)
+            self.clv, self.scaler = fn(
+                self.clv, self.scaler, st.base, st.lidx, st.ridx,
+                st.lcode, st.rcode, zl, zr, self.models, self.block_part,
+                self.tips)
+            self._install_row_map(st)
+            return None
+        fn = self._fast_fn_flat(st.profile, with_eval=True)
+        zv = jnp.asarray(_z_slots(z, self.num_branch_slots),
+                         dtype=self.dtype)
+        self.clv, self.scaler, out = fn(
+            self.clv, self.scaler, st.base, st.lidx, st.ridx, st.lcode,
+            st.rcode, zl, zr, jnp.int32(self._gidx_of(st, p_num)),
+            jnp.int32(self._gidx_of(st, q_num)), zv, self.models,
+            self.block_part, self.weights, self.tips)
+        self._install_row_map(st)
+        return np.asarray(out)
 
     @property
     def pallas_precision(self):
@@ -1056,7 +1214,8 @@ class LikelihoodEngine:
                 return self.ntips + base + (ident - SLOT0)
             return self._gidx(ident)
 
-        return self._pack_traversal(pseudo, parent_row, gidx)
+        with obs.timer("host_schedule"):
+            return self._pack_traversal(pseudo, parent_row, gidx)
 
     def _scan_dispatch_arrays(self, plan, base: int, T: int):
         """Shared padding/chunk plumbing for the scan programs: gather
@@ -1226,6 +1385,19 @@ class LikelihoodEngine:
     def _traverse_evaluate(self, entries: List[TraversalEntry], p_num: int,
                            q_num: int, z: Sequence[float],
                            full: bool = False) -> np.ndarray:
+        if isinstance(entries, FlatTraversal):
+            flat = entries
+            if full and flat.n and self._fast_eligible_flat(flat):
+                try:
+                    out = self._run_fast_flat(flat, p_num, q_num, z)
+                    self._pallas_proven = self.use_pallas
+                    return out
+                except Exception as exc:       # Mosaic lowering/compile
+                    if not self.use_pallas or self._pallas_proven:
+                        raise
+                    self._pallas_failed(exc)
+                    return self._run_fast_flat(flat, p_num, q_num, z)
+            entries = flat.to_entries()
         if full and entries and self._fast_eligible(entries):
             try:
                 out = self._trav_eval_fast(entries, p_num, q_num, z)

@@ -20,10 +20,16 @@ and XLA actually reward (measured, tools/perf_lab.py):
   in place — the `.at[].set` scatter inside scan was measured to copy
   the whole CLV buffer every step (half the runtime).
 
-The engine caches the jitted chunk-runner per wave profile (the schedule
-itself is rebuilt per call — branch lengths change every traversal) and
-keeps a node->row map so the scan path (partial traversals during search)
-and this path share one arena.
+The engine caches the jitted chunk-runner per wave profile AND the
+schedule's immutable structure per topology signature (`FastStructure`,
+built at array rate from a `FlatTraversal` by `build_structure`): only
+the per-chunk zl/zr branch arrays are rebuilt per call (`refresh_z`) —
+branch lengths change every traversal, the chunk layout only on
+topology changes.  A node->row map lets the scan path (partial
+traversals during search) and this path share one arena.  The legacy
+per-entry `build_schedule` remains as the uncached reference
+implementation (equivalence-tested, and still used for entry-list
+callers like bench tiers and bank warming).
 """
 
 from __future__ import annotations
@@ -61,6 +67,114 @@ class FastSchedule(NamedTuple):
     profile: Tuple[Tuple[int, int], ...]   # ((kind, width), ...) jit key
     num_rows: int               # rows actually holding real entries
     max_write: int              # highest row index written + 1 (incl. spill)
+
+
+class FastStructure(NamedTuple):
+    """The IMMUTABLE half of a fast-path schedule: everything that is a
+    function of topology + traversal root only (chunk kinds/widths,
+    child index/code arrays, the arena row map) — cacheable across the
+    branch-length-only traversals that dominate model optimization and
+    repeated full evaluations.  The cheap DYNAMIC half (per-chunk
+    zl/zr) is rebuilt per call by `refresh_z` through the stored
+    entry->slot permutation.
+
+    Child/code arrays are stored PACKED along one padded slot axis
+    (device-resident, transferred once); the jitted program slices each
+    chunk's window statically from the profile, so a cached dispatch
+    ships only the two fresh z arrays to the device."""
+    profile: Tuple[Tuple[int, int], ...]   # ((kind, width), ...) jit key
+    base: jax.Array             # [n_chunks] int32: first arena row written
+    lidx: jax.Array             # [P] packed left-child arena rows
+    ridx: jax.Array             # [P]
+    lcode: jax.Array            # [P] packed 0-based tip indices
+    rcode: jax.Array            # [P]
+    row_of: np.ndarray          # [2*ntips-1] node number -> row (-1 tips)
+    z_src: np.ndarray           # [P] flat-entry index per slot (-1 pad)
+    z_swap: np.ndarray          # [P] slot's children were canonicalized
+    num_rows: int
+    max_write: int
+
+
+def build_structure(flat, ntips: int) -> FastStructure:
+    """Vectorized schedule-structure build from a FlatTraversal: the
+    per-entry Python loop of `build_schedule` replaced by numpy sort/
+    scatter over the whole traversal (this is what makes a 120k-taxon
+    schedule build array-rate).  Produces the identical chunk layout —
+    same (wave, kind) grouping, same pow2 widths, same row assignment
+    discipline — as `build_schedule` on the same wave order."""
+    n = flat.n
+    left = flat.left
+    right = flat.right
+    wave_id = np.repeat(np.arange(flat.wave_sizes.shape[0], dtype=np.int64),
+                        flat.wave_sizes)
+    lt = left <= ntips
+    rt = right <= ntips
+    swap = (~lt) & rt                     # canonicalize: tip child left
+    el = np.where(swap, right, left)
+    er = np.where(swap, left, right)
+    kind = 2 - (lt.astype(np.int64) + rt.astype(np.int64))
+    order = np.argsort(wave_id * 3 + kind, kind="stable")
+    # Row of an entry = its position in (wave, kind)-sorted order: waves
+    # pack consecutively, kind groups advance by their REAL size (pow2
+    # spill overwrites later rows before anything reads them).
+    row_of = np.full(2 * ntips - 1, -1, dtype=np.int64)
+    row_of[flat.parent[order]] = np.arange(n)
+    skey = (wave_id * 3 + kind)[order]
+    starts = np.flatnonzero(np.r_[True, skey[1:] != skey[:-1]])
+    sizes = np.diff(np.r_[starts, n])
+    widths = np.asarray([_pow2(int(g)) for g in sizes], dtype=np.int64)
+    poff = np.concatenate([[0], np.cumsum(widths)[:-1]])
+    P = int(widths.sum())
+    kinds = kind[order][starts]
+    profile = tuple((int(k), int(w)) for k, w in zip(kinds, widths))
+    # Packed slot layout: destination of sorted entry i.
+    dst = (np.repeat(poff, sizes)
+           + np.arange(n) - np.repeat(starts, sizes))
+    el_s = el[order]
+    er_s = er[order]
+    lt_s = (lt | rt)[order]               # post-swap: left tip (kind 0/1)
+    rt_s = (lt & rt)[order]               # post-swap: right tip (kind 0)
+    lidx = np.zeros(P, np.int32)
+    ridx = np.zeros(P, np.int32)
+    lcode = np.zeros(P, np.int32)
+    rcode = np.zeros(P, np.int32)
+    z_src = np.full(P, -1, np.int64)
+    z_swap = np.zeros(P, bool)
+    lidx[dst] = np.where(lt_s, 0, row_of[el_s])
+    ridx[dst] = np.where(rt_s, 0, row_of[er_s])
+    lcode[dst] = np.where(lt_s, el_s - 1, 0)
+    rcode[dst] = np.where(rt_s, er_s - 1, 0)
+    z_src[dst] = order
+    z_swap[dst] = swap[order]
+    dev = jax.device_put([starts.astype(np.int32), lidx, ridx, lcode,
+                          rcode])
+    return FastStructure(profile=profile, base=dev[0], lidx=dev[1],
+                         ridx=dev[2], lcode=dev[3], rcode=dev[4],
+                         row_of=row_of, z_src=z_src, z_swap=z_swap,
+                         num_rows=n,
+                         max_write=int((starts + widths).max()) if n else 0)
+
+
+def refresh_z(st: FastStructure, flat, num_slots: int, dtype):
+    """The DYNAMIC half of a cached schedule: permute the traversal's
+    branch-length vectors into packed chunk-slot order (canonical swap
+    applied, padding slots at z=1) — pure numpy fancy indexing, the
+    only per-call host work on a schedule-cache hit."""
+    zl_f = flat.zl
+    zr_f = flat.zr
+    if zl_f.shape[1] != num_slots:
+        from examl_tpu.utils import z_slots
+        zl_f = np.stack([z_slots(z, num_slots) for z in zl_f])
+        zr_f = np.stack([z_slots(z, num_slots) for z in zr_f])
+    P = st.z_src.shape[0]
+    ok = st.z_src >= 0
+    src = st.z_src[ok]
+    sw = st.z_swap[ok, None]
+    zl = np.ones((P, num_slots))
+    zr = np.ones((P, num_slots))
+    zl[ok] = np.where(sw, zr_f[src], zl_f[src])
+    zr[ok] = np.where(sw, zl_f[src], zr_f[src])
+    return jax.device_put([np.asarray(zl, dtype), np.asarray(zr, dtype)])
 
 
 def _pow2(n: int) -> int:

@@ -178,7 +178,12 @@ def pack_partitions(partitions: Sequence[PartitionData],
         lane=lane, block_multiple=block_multiple)
 
     buckets: Dict[int, PackedBucket] = {}
+    from examl_tpu.resilience import heartbeat
     for states, group in sorted(by_states.items()):
+        # Liveness per bucket: packing a reference-scale (~120k taxon)
+        # alignment is minutes of host work the --supervise stall
+        # detector must not read as a wedge.
+        heartbeat.phase_beat("PACK")
         ntaxa = group[0][1].patterns.shape[0]
         undet = group[0][1].datatype.undetermined_code
         lay = layouts[states]

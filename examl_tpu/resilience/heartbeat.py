@@ -8,7 +8,10 @@ recv, or a multi-host peer stalls inside a psum — hangs the main thread
 with no Python-level recourse, and only an outside watcher can act.
 
 The search loop therefore calls `beat()` on every iteration (SPR slot,
-optimizer round, evaluated tree).  When `EXAML_HEARTBEAT_FILE` is set
+optimizer round, evaluated tree), and the long HOST-SIDE setup phases
+call `phase_beat()` (PARSE/PACK/SCHEDULE — tree build loops,
+alignment packing, schedule assembly) so a legitimate 120k-taxon
+setup never reads as a wedge.  When `EXAML_HEARTBEAT_FILE` is set
 (the supervisor sets it; operators may too) each rate-limited beat
 atomically publishes a small JSON record: timestamp, pid, sequence
 number, loop state, and a snapshot of the obs registry's counters — so
@@ -66,6 +69,24 @@ def beat(state: str = "") -> None:
     faults.fire("search.kill")
     if faults.fire("heartbeat.stall"):
         _STATE["stalled"] = True
+    _publish(state)
+
+
+def phase_beat(state: str = "") -> None:
+    """Liveness from long HOST-SIDE setup phases (PARSE/PACK/SCHEDULE):
+    a legitimate 120k-taxon tree build or schedule assembly must not
+    read as a dispatch wedge to the `--supervise` stall detector, which
+    until now only saw beats from the search loop.
+
+    Publishes exactly like `beat()` (same file, same rate limit, same
+    stall-injection suppression) but does NOT tick the `search.kill` /
+    `heartbeat.stall` fault points — those count SEARCH iterations, and
+    setup-phase liveness must not shift the `after=N` addressing chaos
+    tests rely on."""
+    _publish(state)
+
+
+def _publish(state: str) -> None:
     if _STATE["stalled"]:
         return
     if not _STATE["installed"]:

@@ -152,6 +152,12 @@ def plan_for_endpoints(inst, tree: Tree, p: Node, q1: Node, q2: Node,
     if not candidates:
         return None
 
+    # Invalidation seam: this plan is built against the PRUNED topology
+    # (remove_node's hookup already bumped the tree topology clock, so
+    # any flat-traversal/schedule-structure cache from before the prune
+    # is already unservable by key); the scan itself dispatches only
+    # partial traversals, which never consult the cached structures.
+    #
     # Down-CLV orientation: every gathered node must view away from the
     # merged edge; compute_traversal resolves staleness via the x-flags
     # (dedup by parent -- windows overlap heavily).  The deduped union

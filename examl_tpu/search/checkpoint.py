@@ -323,5 +323,10 @@ class CheckpointManager:
                 "use the same alignment, partitions, and model flags")
         _restore_models(inst, blob["models"])
         TreeSnapshot.from_dict(blob["tree"]).restore_into(tree)
+        # -R restore: the resumed search starts from a COLD schedule
+        # cache — a pre-restore structure must not linger (the signature
+        # keys would reject it anyway; this makes the cold start
+        # explicit and counted).
+        inst.invalidate_schedules()
         inst.evaluate(tree, full=True)
         return {"state": blob["state"], "extras": blob["extras"]}

@@ -136,7 +136,8 @@ class BestList:
         (reference restoreTree ends with evaluateGeneric, `topologies.c:364`)."""
         snap = self.entries[rank - 1]
         snap.restore_into(tree)
-        return inst.evaluate(tree, full=True)
+        inst.invalidate_schedules()     # topology swap: drop cached
+        return inst.evaluate(tree, full=True)   # schedule structures
 
     # checkpoint (de)serialization ------------------------------------------
 

@@ -245,6 +245,12 @@ def restore_tree_fast(inst: PhyloInstance, tree: Tree,
     (reference `restoreTreeFast`)."""
     remove_node_restore(inst, tree, ctx, ctx.remove_node)
     test_insert_restore(inst, tree, ctx, ctx.remove_node, ctx.insert_node)
+    # Committed topology change: drop the engines' cached schedule
+    # structures (the topology-signature keys make staleness impossible
+    # either way — this is memory hygiene + the obs invalidation
+    # evidence; the host-side flat caches self-invalidate via the
+    # topology clock the hookups above bumped).
+    inst.invalidate_schedules()
 
 
 def save_candidate_topology(inst: PhyloInstance, tree: Tree, ctx: SprContext,

@@ -37,8 +37,21 @@ class Node:
         return f"<Node {self.number} back={b} x={self.x}>"
 
 
+_TOPO_CLOCK = [0]
+"""Global monotonic topology clock: bumped by every `hookup` that
+CHANGES a back pointer (pure branch-length rewrites of an existing
+branch don't count).  Every topology mutation in the codebase — SPR
+prune/regraft, NNI-style swaps, tree construction, snapshot restore —
+passes through `hookup` with at least one changed back pointer, so a
+tree whose traversal caches carry an unchanged clock value is
+guaranteed structurally identical (the cheap validity check behind
+`Tree.flat_full_traversal`'s host-side caching)."""
+
+
 def hookup(p: Node, q: Node, z: Sequence[float]) -> None:
     """Connect two slots with a shared branch-length vector."""
+    if p.back is not q or q.back is not p:
+        _TOPO_CLOCK[0] += 1
     p.back = q
     q.back = p
     shared = [min(max(v, ZMIN), ZMAX) for v in z]
@@ -62,6 +75,65 @@ class TraversalEntry:
         return f"TE(p={self.parent},l={self.left},r={self.right})"
 
 
+class FlatTraversal:
+    """Array-form FULL traversal rooted at an edge (tentpole of the host-
+    path scale work): entry i recomputes inner node ``parent[i]`` from
+    children ``(left[i], right[i])`` with branch-length vectors
+    ``zl[i]/zr[i]``.  Entries are wave-major (ASAP level order, exactly
+    `Tree.schedule_waves` semantics) so consumers never re-derive the
+    dependency structure.
+
+    ``topo_key`` digests ONLY the structural arrays (parent/left/right
+    + ntips) — it identifies the schedule STRUCTURE independent of
+    branch lengths, which is what lets the engine cache the expensive
+    chunk layout and refresh only z on repeated fixed-topology
+    traversals (ops/engine.py sched cache).  The digest is 128-bit
+    blake2b: self-validating, so SPR/NNI topology changes can never be
+    served a stale structure even without an explicit invalidation
+    call.
+    """
+
+    __slots__ = ("parent", "left", "right", "zl", "zr", "wave_sizes",
+                 "n", "ntips", "topo_key", "_entries")
+
+    def __init__(self, parent, left, right, zl, zr, wave_sizes,
+                 ntips: int):
+        import hashlib
+        self.parent = parent          # [n] int64 node numbers
+        self.left = left              # [n] int64
+        self.right = right            # [n] int64
+        self.zl = zl                  # [n, C] float64
+        self.zr = zr                  # [n, C] float64
+        self.wave_sizes = wave_sizes  # [n_waves] int64
+        self.n = int(parent.shape[0])
+        self.ntips = ntips
+        h = hashlib.blake2b(digest_size=16)
+        h.update(np.int64(ntips).tobytes())
+        h.update(np.ascontiguousarray(parent, dtype=np.int64).tobytes())
+        h.update(np.ascontiguousarray(left, dtype=np.int64).tobytes())
+        h.update(np.ascontiguousarray(right, dtype=np.int64).tobytes())
+        self.topo_key = h.digest()
+        self._entries: Optional[List[TraversalEntry]] = None
+
+    def to_entries(self) -> List[TraversalEntry]:
+        """Materialize as the legacy TraversalEntry list (scan-tier /
+        PSR / SEV consumers).  Wave-major order is a valid post-order,
+        so `Tree.schedule_waves` reproduces the identical waves.
+        Memoized — multiple engines share one conversion."""
+        if self._entries is None:
+            zl = self.zl.tolist()
+            zr = self.zr.tolist()
+            self._entries = [
+                TraversalEntry(p, l, r, a, b)
+                for p, l, r, a, b in zip(self.parent.tolist(),
+                                         self.left.tolist(),
+                                         self.right.tolist(), zl, zr)]
+        return self._entries
+
+    def __len__(self) -> int:
+        return self.n
+
+
 class Tree:
     """Unrooted strictly-binary tree over tips 1..ntips."""
 
@@ -74,6 +146,10 @@ class Tree:
         for i in range(1, ntips + 1):
             self.nodep[i] = Node(i)
         self._next_inner = ntips + 1
+        # Host-side traversal caches, validated against _TOPO_CLOCK
+        # (flat_full_traversal structures; the memoized centroid edge).
+        self._flat_caches: Dict[int, dict] = {}
+        self._centroid_cache: Optional[Tuple[int, Node]] = None
 
     # -- structure helpers -------------------------------------------------
 
@@ -135,9 +211,17 @@ class Tree:
 
             Iterative post-order (results memoized by id) — reference-scale
             trees exceed the recursion limit (SURVEY §6)."""
+            from examl_tpu.resilience import heartbeat
             done: Dict[int, Node] = {}
             stack: List[Tuple[NewickNode, bool]] = [(nw, False)]
+            steps = 0
             while stack:
+                steps += 1
+                if not (steps & 0xFFFF):
+                    # Liveness during a reference-scale (~120k taxon)
+                    # build: a --supervise stall detector must see setup
+                    # phases breathing, not just the search loop.
+                    heartbeat.phase_beat("PARSE")
                 n, expanded = stack.pop()
                 if n.is_leaf:
                     try:
@@ -191,7 +275,10 @@ class Tree:
         branches = [(center, center.back),
                     (center.next, center.next.back),
                     (center.next.next, center.next.next.back)]
-        for num in order[3:]:
+        from examl_tpu.resilience import heartbeat
+        for step, num in enumerate(order[3:]):
+            if not (step & 0xFFFF):
+                heartbeat.phase_beat("PARSE")
             i = int(rng.integers(len(branches)))
             p, q = branches[i]
             inner = tree.new_inner()
@@ -259,7 +346,30 @@ class Tree:
         newview step — the TPU replacement for the reference's strictly
         sequential traversal replay (`newviewIterative`,
         `newviewGenericSpecial.c:917-1515`).
+
+        Large traversals (full-tree rebuilds at reference scale, SURVEY
+        §6) take a vectorized path: level propagation runs as numpy
+        scatter/gather per wave instead of a per-entry dict crawl, which
+        is what keeps a 120k-taxon wave schedule at array rate.  The
+        vectorized branch requires each parent to appear once (always
+        true for full traversals); repeated parents — merged multi-root
+        partial traversals (search/batchscan.py) — keep the loop, whose
+        last-write-wins level semantics they rely on.
         """
+        n = len(entries)
+        if n >= 512:
+            parent = np.fromiter((e.parent for e in entries), np.int64, n)
+            uniq = np.unique(parent)
+            if uniq.shape[0] == n:
+                left = np.fromiter((e.left for e in entries), np.int64, n)
+                right = np.fromiter((e.right for e in entries), np.int64, n)
+                order, wave_sizes = _wave_order(parent, left, right)
+                waves = []
+                off = 0
+                for w in wave_sizes:
+                    waves.append([entries[i] for i in order[off:off + w]])
+                    off += w
+                return waves
         level: Dict[int, int] = {}
         waves: List[List[TraversalEntry]] = []
         for e in entries:
@@ -269,6 +379,153 @@ class Tree:
                 waves.append([])
             waves[lv].append(e)
         return waves
+
+    def flat_full_traversal(self, p: Node) -> FlatTraversal:
+        """Array-rate full traversal rooted at the edge (p, p.back).
+
+        The vectorized replacement for the full-traversal branch of
+        `compute_traversal` + `schedule_waves` + per-entry schedule
+        assembly: ONE minimal Python pass extracts the pointer structure
+        into numpy arrays (the unavoidable cost of leaving the
+        reference's node-cycle data model), then rooting (frontier BFS),
+        ASAP wave levels (Kahn), and entry assembly all run as array
+        ops.  Equivalent to `invalidate_all()` followed by
+        `compute_traversal(p, full=True)` + `compute_traversal(p.back,
+        full=True)`: the same entry set, the same wave partition, and
+        the same final x-flag orientation (every inner node oriented
+        toward the root edge) — proven by tests/test_sched_cache.py.
+
+        The structural result (rooting, wave order, child arrays) is a
+        function of topology + root edge only, so it is cached on the
+        tree and validated against the module topology clock (`hookup`
+        bumps it on every back-pointer change): the branch-length-only
+        traversals that dominate model optimization and makenewz rounds
+        re-read just the z vectors and re-orient the x flags.
+        """
+        cache = self._flat_caches.get(id(p))
+        if (cache is not None and cache["clock"] == _TOPO_CLOCK[0]
+                and cache["root"] is p):
+            return self._flat_from_cache(cache)
+        cache = self._flat_build_cache(p)
+        self._flat_caches[id(p)] = cache
+        while len(self._flat_caches) > 4:
+            self._flat_caches.pop(next(iter(self._flat_caches)))
+        return self._flat_from_cache(cache)
+
+    def _flat_build_cache(self, p: Node) -> dict:
+        """The structural (topology + root only) half of a flat full
+        traversal; everything here is skipped on a cache hit."""
+        from examl_tpu.resilience import heartbeat
+
+        ntips = self.ntips
+        n_inner = self._next_inner - ntips - 1
+        q = p.back
+        heartbeat.phase_beat("SCHEDULE")
+        # 1. Extraction: canonical slot triples -> neighbor numbers
+        #    (tight loop, tiny body; flat int list -> one np.fromiter).
+        nodep = self.nodep
+        nb_flat: List[int] = []
+        extend = nb_flat.extend
+        slot0: List[Node] = []
+        sappend = slot0.append
+        for num in range(ntips + 1, self._next_inner):
+            s0 = nodep[num]
+            s1 = s0.next
+            s2 = s1.next
+            extend((s0.back.number, s1.back.number, s2.back.number))
+            sappend(s0)
+            if not (num & 0xFFFF):
+                heartbeat.phase_beat("SCHEDULE")
+        nb = np.fromiter(nb_flat, np.int64, 3 * n_inner).reshape(-1, 3)
+        # 2. Rooting: frontier BFS from the edge endpoints assigns each
+        #    inner node the slot index facing the root edge.
+        parent_j = np.full(n_inner, -1, dtype=np.int64)
+        init = []
+        for s in (p, q):
+            if s.number > ntips:
+                i = s.number - ntips - 1
+                c = nodep[s.number]
+                j = 0 if s is c else (1 if s is c.next else 2)
+                parent_j[i] = j
+                init.append(i)
+        frontier = np.asarray(init, dtype=np.int64)
+        while frontier.size:
+            k = frontier.shape[0]
+            keep = np.ones((k, 3), dtype=bool)
+            keep[np.arange(k), parent_j[frontier]] = False
+            cand = nb[frontier][keep]                     # [2k] slot order
+            m = cand > ntips
+            new_nums = cand[m]
+            if not new_nums.size:
+                break
+            new_idx = new_nums - ntips - 1
+            par_nums = np.repeat(frontier + ntips + 1, 2)[m]
+            parent_j[new_idx] = np.argmax(
+                nb[new_idx] == par_nums[:, None], axis=1)
+            frontier = new_idx
+        assert (parent_j >= 0).all(), "tree not connected from root edge"
+        # 3. Children in slot order from the parent-facing slot — exactly
+        #    compute_traversal's (s.next.back, s.next.next.back).
+        ar = np.arange(n_inner)
+        lj = (parent_j + 1) % 3
+        rj = (parent_j + 2) % 3
+        left = nb[ar, lj]
+        right = nb[ar, rj]
+        parent_nums = ar + ntips + 1
+        # 4. ASAP wave order (vectorized Kahn).
+        order, wave_sizes = _wave_order(parent_nums, left, right)
+        heartbeat.phase_beat("SCHEDULE")
+        # 5. The z-read plan: the slot objects owning each sorted entry's
+        #    two branch vectors (z lists may be REBOUND by hookup, so the
+        #    cache holds the slots, not the lists).
+        slot_at = {0: slot0, 1: [s.next for s in slot0],
+                   2: [s.next.next for s in slot0]}
+        ot = order.tolist()
+        ljt = lj.tolist()
+        rjt = rj.tolist()
+        zl_slots = [slot_at[ljt[i]][i] for i in ot]
+        zr_slots = [slot_at[rjt[i]][i] for i in ot]
+        proto = FlatTraversal(parent_nums[order], left[order],
+                              right[order],
+                              np.ones((n_inner, self.num_branches)),
+                              np.ones((n_inner, self.num_branches)),
+                              wave_sizes, ntips)
+        return {"clock": _TOPO_CLOCK[0], "root": p, "proto": proto,
+                "slot0": slot0, "pj": parent_j.tolist(),
+                "zl_slots": zl_slots, "zr_slots": zr_slots}
+
+    def _flat_from_cache(self, cache: dict) -> FlatTraversal:
+        """The per-call half: re-read branch vectors through the cached
+        slot plan, re-orient the x flags, stamp fresh z arrays onto the
+        cached structural prototype."""
+        proto = cache["proto"]
+        C = self.num_branches
+        if C == 1:
+            zl = np.fromiter((s.z[0] for s in cache["zl_slots"]),
+                             np.float64, proto.n).reshape(-1, 1)
+            zr = np.fromiter((s.z[0] for s in cache["zr_slots"]),
+                             np.float64, proto.n).reshape(-1, 1)
+        else:
+            zl = np.asarray([s.z for s in cache["zl_slots"]], np.float64)
+            zr = np.asarray([s.z for s in cache["zr_slots"]], np.float64)
+        for s0, j in zip(cache["slot0"], cache["pj"]):
+            s1 = s0.next
+            s2 = s1.next
+            s0.x = j == 0
+            s1.x = j == 1
+            s2.x = j == 2
+        flat = FlatTraversal.__new__(FlatTraversal)
+        flat.parent = proto.parent
+        flat.left = proto.left
+        flat.right = proto.right
+        flat.zl = zl
+        flat.zr = zr
+        flat.wave_sizes = proto.wave_sizes
+        flat.n = proto.n
+        flat.ntips = proto.ntips
+        flat.topo_key = proto.topo_key
+        flat._entries = None
+        return flat
 
     def full_traversal(self) -> Tuple[Node, List[TraversalEntry]]:
         """Traversal making both ends of the branch at `start` valid."""
@@ -285,8 +542,16 @@ class Tree:
         the analogue of picking a good virtual root, a freedom the
         reference's strictly sequential `newviewIterative` never needed.
         Classic double-BFS: the middle edge of a diameter path.
+        Memoized against the topology clock — the centroid is a function
+        of topology alone, and the double-BFS is an interpreter-rate
+        walk that would otherwise dominate every cached full traversal
+        at reference scale.
         """
         from collections import deque
+
+        if (self._centroid_cache is not None
+                and self._centroid_cache[0] == _TOPO_CLOCK[0]):
+            return self._centroid_cache[1]
 
         def bfs(src: Node):
             # Walk slots; returns (farthest tip number, parents map by id).
@@ -316,10 +581,13 @@ class Tree:
         mid = path[len(path) // 2]
         mid_next = path[max(len(path) // 2 - 1, 0)]
         # return the slot of `mid` whose back is `mid_next`
+        out = self.nodep[mid]
         for slot in self.slots(mid):
             if slot.back is not None and slot.back.number == mid_next:
-                return slot
-        return self.nodep[mid]
+                out = slot
+                break
+        self._centroid_cache = (_TOPO_CLOCK[0], out)
+        return out
 
     def full_traversal_centroid(self) -> Tuple[Node, List[TraversalEntry]]:
         """Full traversal rooted at the centroid branch (minimum wave depth)."""
@@ -397,6 +665,61 @@ class Tree:
                                     length=t_of(start.z[branch_index]))]
         root.children.extend(inner.children)
         return format_newick(root, with_lengths=with_lengths)
+
+
+def _wave_order(parent: np.ndarray, left: np.ndarray,
+                right: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """Vectorized ASAP wave scheduling over entry arrays (parents must
+    be unique).  Returns (order, wave_sizes): `order` lists entry
+    indices wave-major, ascending within each wave — identical
+    membership AND order to the dict-based `Tree.schedule_waves` on the
+    same input.  Per-wave work is numpy scatter/gather, so the total
+    cost is O(n) plus a small fixed overhead per wave (= schedule
+    depth), instead of a per-entry interpreter crawl."""
+    n = parent.shape[0]
+    if n == 0:
+        return np.empty(0, np.int64), np.empty(0, np.int64)
+    maxnum = int(max(parent.max(), left.max(), right.max())) + 1
+    pos = np.full(maxnum, -1, dtype=np.int64)
+    pos[parent] = np.arange(n)
+    li = pos[left]                    # entry computing the left child, -1
+    ri = pos[right]                   # if the child is a tip / external
+    remaining = (li >= 0).astype(np.int64) + (ri >= 0)
+    # Reverse adjacency (entry -> dependents), grouped by sorting.
+    child_idx = np.concatenate([li, ri])
+    dep_entry = np.concatenate([np.arange(n), np.arange(n)])
+    m = child_idx >= 0
+    child_idx = child_idx[m]
+    dep_entry = dep_entry[m]
+    so = np.argsort(child_idx, kind="stable")
+    child_sorted = child_idx[so]
+    dep_sorted = dep_entry[so]
+    starts = np.searchsorted(child_sorted, np.arange(n))
+    ends = np.searchsorted(child_sorted, np.arange(n), side="right")
+    order_parts: List[np.ndarray] = []
+    wave_sizes: List[int] = []
+    frontier = np.flatnonzero(remaining == 0)
+    scheduled = 0
+    while frontier.size:
+        order_parts.append(frontier)
+        wave_sizes.append(int(frontier.size))
+        scheduled += int(frontier.size)
+        counts = ends[frontier] - starts[frontier]
+        total = int(counts.sum())
+        if total == 0:
+            break
+        seg0 = np.cumsum(counts) - counts
+        idx = (np.repeat(starts[frontier], counts)
+               + np.arange(total) - np.repeat(seg0, counts))
+        deps = dep_sorted[idx]
+        np.subtract.at(remaining, deps, 1)
+        cand = np.unique(deps)
+        frontier = cand[remaining[cand] == 0]
+    if scheduled != n:
+        raise ValueError(
+            f"cyclic or disconnected traversal: scheduled {scheduled} "
+            f"of {n} entries")
+    return np.concatenate(order_parts), np.asarray(wave_sizes, np.int64)
 
 
 def _z_of(nw: NewickNode, num_branches: int) -> List[float]:

@@ -1,0 +1,283 @@
+"""Large-tree host-path scale lab (ROADMAP item 4: the scale-credibility
+artifact).
+
+The README and native/newickscan.cpp repeat the reference's ~120k-taxon
+ambition (SURVEY §6); this lab is the honest run behind the claim: a
+synthetic 50k- and 120k-taxon HOST-PATH pipeline — newick parse (native
+scanner when built), alignment pack + engine construction, fast-path
+schedule build (legacy per-entry loop vs the vectorized + structure-
+cached path), and one real scan-tier full traversal on CPU — with
+per-phase wall timings and peak RSS recorded to SCALE.md.
+
+No accelerator is required: everything here is the HOST floor, the part
+of the system that must stay interactive no matter what the chip does
+(BEAGLE's lesson — once device kernels are fused, host-side operation
+scheduling is the next dominant cost).
+
+Usage:
+  python tools/scale_lab.py [--sizes 50000,120000] [--patterns 128]
+                            [--out SCALE.md]
+  python tools/scale_lab.py --smoke      # 5k-taxon CI smoke, asserts
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+REPEATS = 5          # repeated fixed-topology traversals (the hit path)
+
+
+def _rss_mb() -> float:
+    import resource
+    div = 1024.0 * 1024.0 if sys.platform == "darwin" else 1024.0
+    return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / div
+
+
+class Phases:
+    def __init__(self):
+        self.rows = []          # (name, seconds, peak_rss_mb_after)
+
+    def run(self, name, fn):
+        t0 = time.perf_counter()
+        out = fn()
+        dt = time.perf_counter() - t0
+        self.rows.append((name, dt, _rss_mb()))
+        print(f"  {name:34s} {dt:9.3f} s   rss {_rss_mb():8.1f} MB",
+              flush=True)
+        return out
+
+
+def _synthetic_alignment(ntaxa: int, patterns: int):
+    from examl_tpu.io.alignment import build_alignment_data
+    rng = np.random.default_rng(7)
+    names = [f"t{i}" for i in range(ntaxa)]
+    # Distinct rows, vectorized generation (a Python join per taxon
+    # would itself be a scale bug at 120k rows).
+    codes = rng.integers(0, 4, (ntaxa, patterns), dtype=np.int8)
+    lut = np.frombuffer(b"ACGT", dtype=np.uint8)
+    seqs = [bytes(row).decode() for row in lut[codes]]
+    return names, build_alignment_data(names, seqs)
+
+
+def run_size(ntaxa: int, patterns: int, smoke: bool = False) -> dict:
+    import jax.numpy as jnp
+
+    from examl_tpu import obs
+    from examl_tpu.instance import PhyloInstance
+    from examl_tpu.ops import fastpath
+    from examl_tpu.tree.topology import Tree
+
+    print(f"== {ntaxa} taxa x {patterns} patterns ==", flush=True)
+    ph = Phases()
+    res = {"ntaxa": ntaxa, "patterns": patterns}
+
+    names, data = ph.run("alignment (synthetic)",
+                         lambda: _synthetic_alignment(ntaxa, patterns))
+
+    tree = ph.run("tree build (random addition)",
+                  lambda: Tree.random(names, seed=1))
+    text = ph.run("to_newick", lambda: tree.to_newick(names))
+    res["newick_mb"] = round(len(text) / 1e6, 1)
+    tree = ph.run("parse (newickscan + build)",
+                  lambda: Tree.from_newick(text, names))
+
+    inst = ph.run("pack + engines (CLV arena, f32)",
+                  lambda: PhyloInstance(data, dtype=jnp.float32))
+    (eng,) = inst.engines.values()
+    res["clv_arena_mb"] = round(
+        eng.num_rows * eng.B * eng.lane * eng.R * eng.K
+        * np.dtype(eng.storage_dtype).itemsize / 1e6, 1)
+
+    # --- host schedule: BEFORE (legacy per-entry loop) vs AFTER --------
+    p = tree.centroid_branch()
+    if tree.is_tip(p.number):
+        p = p.back
+
+    def legacy_once():
+        tree.invalidate_all()
+        entries = (tree.compute_traversal(p, True)
+                   + tree.compute_traversal(p.back, True))
+        return fastpath.build_schedule(entries, ntaxa,
+                                       inst.num_branch_slots, eng.dtype)
+    sched = ph.run("schedule BEFORE (legacy, per-entry)", legacy_once)
+    res["chunks"] = len(sched.chunks)
+    del sched
+
+    flat = ph.run("schedule AFTER cold (flat + structure)",
+                  lambda: tree.flat_full_traversal(p))
+    st = fastpath.build_structure(flat, ntaxa)
+    res["waves"] = int(flat.wave_sizes.shape[0])
+
+    def hit_path():
+        for _ in range(REPEATS):
+            f = tree.flat_full_traversal(p)
+            fastpath.refresh_z(st, f, inst.num_branch_slots, eng.dtype)
+    ph.run(f"schedule AFTER x{REPEATS} (cached, z-only)", hit_path)
+    t_legacy = ph.rows[-3][1]
+    t_cold = ph.rows[-2][1]
+    t_hit = ph.rows[-1][1] / REPEATS
+    res.update(sched_before_s=round(t_legacy, 3),
+               sched_cold_s=round(t_cold, 3),
+               sched_hit_s=round(t_hit, 4),
+               sched_speedup_repeat=round(t_legacy / t_hit, 1),
+               sched_speedup_cold=round(t_legacy / t_cold, 1))
+
+    # --- one real scan-tier traversal + root lnL on CPU ----------------
+    for e in inst.engines.values():
+        e.force_scan = True
+    lnl = ph.run("scan-tier traversal + lnL (compile+run)",
+                 lambda: inst.evaluate(tree, full=True))
+    lnl2 = ph.run("scan-tier traversal + lnL (warm)",
+                  lambda: inst.evaluate(tree, full=True))
+    assert np.isfinite(lnl) and lnl == lnl2, (lnl, lnl2)
+    res["lnl"] = lnl
+
+    # --- fast-tier (chunk) evaluate through the schedule cache ---------
+    # Small sizes only: the chunk program statically unrolls every
+    # chunk, and ~1500 unrolled MXU dots take XLA tens of minutes to
+    # compile on CPU (on TPU the compile is one-off and bankable;
+    # at CPU scale the scan tier above is the practical tier — which
+    # is exactly why the ISSUE's artifact pins the scan tier).
+    res["lnl_fast"] = None
+    if smoke or ntaxa <= 8000:
+        for e in inst.engines.values():
+            e.force_scan = False
+        lnl_f = ph.run("fast-tier evaluate (compile+run)",
+                       lambda: inst.evaluate(tree, full=True))
+        lnl_f2 = ph.run("fast-tier evaluate (cached structure)",
+                        lambda: inst.evaluate(tree, full=True))
+        assert np.isfinite(lnl_f) and lnl_f == lnl_f2, (lnl_f, lnl_f2)
+        res["lnl_fast"] = lnl_f
+    else:
+        lnl_f = None
+
+    snap = obs.snapshot()
+    res["host_schedule_timer"] = snap["timers"].get("host_schedule")
+    res["sched_cache"] = {
+        k.rsplit(".", 1)[1]: v for k, v in snap["counters"].items()
+        if k.startswith("engine.sched_cache.")}
+    res["phases"] = [(n, round(t, 3), round(r, 1)) for n, t, r in ph.rows]
+    res["peak_rss_mb"] = round(_rss_mb(), 1)
+
+    if smoke:
+        assert res["sched_cache"].get("hit", 0) >= 1, res["sched_cache"]
+        assert res["sched_cache"].get("miss", 0) >= 1, res["sched_cache"]
+        assert abs(lnl - lnl_f) <= max(1e-6 * abs(lnl), 1e-3), \
+            (lnl, lnl_f)            # scan vs chunk tier agreement
+        assert res["sched_speedup_repeat"] >= 2.0, res  # loose CI bound
+    del inst, eng                   # free the arena before the next size
+    return res
+
+
+def to_markdown(results, argv) -> str:
+    import platform
+    lines = [
+        "# SCALE — large-tree host-path runs (ROADMAP item 4)",
+        "",
+        "The honest run behind the 120k-taxon claim: synthetic DNA "
+        "alignments, random-addition trees, and the full HOST pipeline "
+        "— newick parse (native scanner), pack + engine build, "
+        "fast-path schedule build, and a real scan-tier full traversal "
+        "with root lnL on CPU.  Regenerate with "
+        f"`python tools/scale_lab.py {' '.join(argv)}`.",
+        "",
+        f"Host: {platform.processor() or platform.machine()}, "
+        f"python {platform.python_version()}, single process, "
+        "`JAX_PLATFORMS=cpu`, f32 CLV arena.  Peak RSS is cumulative "
+        "process `ru_maxrss` at each phase's end (monotone — the value "
+        "at a phase bounds everything up to it).",
+        "",
+    ]
+    for r in results:
+        fast = ("" if r["lnl_fast"] is None
+                else f" / {r['lnl_fast']:.3f} (chunk tier)")
+        lines += [f"## {r['ntaxa']:,} taxa x {r['patterns']} patterns",
+                  "",
+                  f"newick {r['newick_mb']} MB, CLV arena "
+                  f"{r['clv_arena_mb']} MB (f32), {r['chunks']} chunks "
+                  f"in {r['waves']} waves, lnL {r['lnl']:.3f} "
+                  f"(scan tier){fast}.",
+                  "",
+                  "| phase | seconds | peak RSS (MB) |",
+                  "|---|---|---|"]
+        for name, dt, rss in r["phases"]:
+            lines.append(f"| {name} | {dt:.3f} | {rss:.0f} |")
+        cache = r.get("sched_cache", {})
+        tmr = r.get("host_schedule_timer") or {}
+        lines += [
+            "",
+            f"**Host schedule, repeated fixed-topology traversals**: "
+            f"{r['sched_before_s']:.3f} s/traversal before (per-entry "
+            f"compute_traversal + build_schedule) -> "
+            f"{r['sched_hit_s']*1000:.1f} ms cached "
+            f"(**{r['sched_speedup_repeat']:.0f}x**); cold rebuild "
+            f"{r['sched_cold_s']:.3f} s "
+            f"({r['sched_speedup_cold']:.1f}x).  obs `host_schedule` "
+            f"timer: {tmr.get('count', 0)} builds, "
+            f"{tmr.get('total_s', 0):.3f} s total"
+            + (f"; sched_cache counters: {json.dumps(cache)}"
+               if cache else "") + ".",
+            "",
+        ]
+    lines += [
+        "## Notes",
+        "",
+        "- The schedule-cache speedup is the PR's acceptance metric "
+        "(>=5x on repeated fixed-topology traversals): on a hit, the "
+        "host work is one z re-read through the cached slot plan plus "
+        "`fastpath.refresh_z` fancy indexing — no per-entry Python.",
+        "- The scan-tier traversal row is dominated by its one-off "
+        "XLA compile on the first call; the warm row is the honest "
+        "per-traversal device cost on this CPU.",
+        "- The chunk (fast) tier is measured only at smoke sizes here: "
+        "its statically unrolled chunk program costs XLA tens of "
+        "minutes of CPU compile at ~1500 chunks (one-off and bankable "
+        "on TPU, where that tier belongs; see ops/bank.py).  The "
+        "engine-level sched_cache hit/miss evidence at full size rides "
+        "in `tools/scale_lab.py --smoke` (CI scale-smoke) and "
+        "tests/test_sched_cache.py.",
+        "- Peak RSS includes python + jax + the f32 CLV arena; the "
+        "arena row in each section isolates the dominant allocation.",
+    ]
+    return "\n".join(lines) + "\n"
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--sizes", default="50000,120000")
+    ap.add_argument("--patterns", type=int, default=128)
+    ap.add_argument("--out", default=None, help="write markdown here")
+    ap.add_argument("--smoke", action="store_true",
+                    help="5k-taxon CI smoke with correctness asserts")
+    args = ap.parse_args()
+
+    if args.smoke:
+        res = run_size(5000, 64, smoke=True)
+        print("scale-smoke PASS:",
+              json.dumps({k: res[k] for k in
+                          ("sched_speedup_repeat", "sched_cache",
+                           "peak_rss_mb")}))
+        return
+
+    sizes = [int(s) for s in args.sizes.split(",") if s]
+    results = [run_size(n, args.patterns) for n in sizes]
+    md = to_markdown(results, sys.argv[1:])
+    print(md)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(md)
+
+
+if __name__ == "__main__":
+    main()
