@@ -305,11 +305,20 @@ def _variant_step(eng, variant, entries):
                                     eng.site_rates)
         return step
     if variant in ("chunks", "pallas"):
-        chunks = eng._fast_schedule(entries).chunks
+        from examl_tpu.ops import fastpath
+
+        sched = eng._fast_schedule(entries)
 
         def step(c, s):
             eng.use_pallas = (variant == "pallas")
-            return eng.run_chunks_traced(c, s, chunks)
+            return eng.run_segments_traced(c, s, sched)
+        # Bounded-program evidence for the bench row (ISSUE 5): ops per
+        # traversal (= the launch-latency floor) vs the raw chunk count
+        # the pre-bounded path unrolled.
+        un, sc, total = fastpath.profile_stats(sched.profile)
+        step.program_stats = {"program_chunks": un, "scan_groups": sc,
+                              "dispatches_per_traversal": un + sc,
+                              "chunks_unrolled": total}
         return step
     if variant == "whole":
         from examl_tpu.ops import pallas_whole
@@ -379,7 +388,8 @@ def _measure_variant(inst, tree, eng, entries, variant) -> dict:
     tier = (eng.use_pallas, eng.pallas_whole)
     sched0 = _host_schedule_total()
     try:
-        fn = _chained(_variant_step(eng, variant, entries), n_steps)
+        step = _variant_step(eng, variant, entries)
+        fn = _chained(step, n_steps)
         buf = eng._state()[0] if eng.save_memory else eng.clv
         dt, compile_s, flops = _time_compiled(fn, buf, eng.scaler)
     finally:
@@ -411,6 +421,7 @@ def _measure_variant(inst, tree, eng, entries, variant) -> dict:
         "host_schedule_s": round(_host_schedule_total() - sched0, 4),
         "peak_rss_mb": _peak_rss_mb(),
     }
+    out.update(getattr(step, "program_stats", {}))
     if flops is not None:
         fps = flops / dt
         # MFU vs the bf16 MXU peak (v5e ~197 TFLOP/s; override with

@@ -134,9 +134,18 @@ def enumerate_families(mode: str = "d", psr: bool = False,
                        env: Optional[dict] = None) -> List[str]:
     """The program families a run with this config will dispatch, scan
     tier first (the fallback target must bank before anything that can
-    degrade onto it).  Pure config arithmetic — workers later skip
-    members that turn out inapplicable on the live backend (e.g. the
-    batched SPR scan is accelerator-gated)."""
+    degrade onto it), deduplicated in order.  Pure config arithmetic —
+    workers later skip members that turn out inapplicable on the live
+    backend (e.g. the batched SPR scan is accelerator-gated).
+
+    The `fast` family's per-shape variants are keyed by the BUCKETED
+    chunk profile (ops/fastpath.py: width ladder + coalescing + scan
+    groups), not raw per-chunk widths — topologies of similar shape
+    share one profile, so the family's program set is bounded and a
+    worker-compiled variant is a persistent-cache hit for every later
+    topology minting the same profile (cross-topology reuse is proven
+    by tests/test_fastpath.py and the manifest records the layout
+    constants that key it)."""
     e = os.environ if env is None else env
     fams = list(CORE_FAMILIES)
     if e.get("EXAML_FAST_TRAVERSAL") != "0" and not psr and not save_memory:
@@ -149,7 +158,18 @@ def enumerate_families(mode: str = "d", psr: bool = False,
         fams.append("scan")
         if e.get("EXAML_BATCH_THOROUGH") != "0":
             fams.append("thscan")
-    return fams
+    return list(dict.fromkeys(fams))
+
+
+def chunk_layout_info() -> dict:
+    """The bounded-chunk-layout constants in effect — recorded in the
+    bank manifest so a cache whose layout knobs differ from the current
+    run's is visibly stale (the knobs change the profile alphabet and
+    therefore every `fast`-family program shape)."""
+    from examl_tpu.ops import fastpath
+    mw, cap, tail = fastpath._knobs()
+    return {"bounded": fastpath.bounded_default(), "min_width": mw,
+            "chunk_cap": cap, "tail_width": tail}
 
 
 def spec_from_args(args) -> dict:
@@ -802,6 +822,7 @@ def _save_manifest(cache_path: Optional[str], report: Dict[str, dict],
     try:
         with open(path, "w") as f:
             json.dump({"version": 1, "updated": time.time(),
+                       "chunk_layout": chunk_layout_info(),
                        "families": families}, f, indent=2,
                       sort_keys=True)
         log(f"bank manifest -> {path}")

@@ -70,15 +70,12 @@ from examl_tpu.utils import next_pow2 as _next_pow2
 
 
 def _bucket_len(n: int) -> int:
-    """Round a traversal length up to a bucketed size: multiples of 4 up to
-    16, then geometric buckets with <=25% padding (n rounded up to a
-    multiple of 2^(floor(log2 n) - 2)).  Keeps the number of compiled
-    traversal variants O(log n) while a padding wave costs a full W-wide
-    newview, so the waste per call stays bounded."""
-    if n <= 16:
-        return 4 * ((n + 3) // 4)
-    step = _next_pow2(n + 1) // 8
-    return step * ((n + step - 1) // step)
+    """Round a traversal length up to a bucketed size (utils.bucket_len:
+    multiples of 4 up to 16, then <=25% geometric buckets).  Keeps the
+    number of compiled traversal variants O(log n) while a padding wave
+    costs a full W-wide newview, so the waste per call stays bounded."""
+    from examl_tpu.utils import bucket_len
+    return bucket_len(n)
 
 
 class LikelihoodEngine:
@@ -118,8 +115,14 @@ class LikelihoodEngine:
         import os as _fos
         self.force_scan = _fos.environ.get("EXAML_FAST_TRAVERSAL",
                                            "") == "0"
+        # Slack floor: the bounded chunk layout pads narrow chunks up to
+        # the width floor and points the scanned tail's padding
+        # sub-chunks at the slack region, so the arena headroom follows
+        # the live layout knobs (fastpath.slack_rows; the build asserts
+        # max_write fits in any case).
+        from examl_tpu.ops import fastpath as _fastpath
         self.fast_slack = (0 if psr or save_memory
-                           else min(64, _next_pow2(ntips)))
+                           else _fastpath.slack_rows(ntips))
         self.num_rows = self.n_inner + self.fast_slack + 1
         self.scratch_row = self.num_rows - 1
         self.row_map = np.full(2 * ntips - 1, -1, dtype=np.int64)
@@ -851,12 +854,12 @@ class LikelihoodEngine:
             self._run_whole(entries)
             return
         sched = self._fast_schedule(entries)
-        fn = self._fast_fn(sched.profile, with_eval=False)
-        data = tuple((c.base, c.lidx, c.ridx, c.lcode, c.rcode,
-                      c.zl, c.zr) for c in sched.chunks)
-        self.clv, self.scaler = fn(self.clv, self.scaler, data,
-                                   self.models, self.block_part,
-                                   self.tips)
+        self._note_fast_program(sched.profile)
+        fn = self._fast_fn_flat(sched.profile, with_eval=False)
+        self.clv, self.scaler = fn(
+            self.clv, self.scaler, sched.base, sched.lidx, sched.ridx,
+            sched.lcode, sched.rcode, sched.zl, sched.zr, self.models,
+            self.block_part, self.tips)
         self._install_row_map(sched)
 
     # -- engine state: dense CLV buffer or SEV pool -------------------------
@@ -964,41 +967,51 @@ class LikelihoodEngine:
         return (not self.psr and not self.force_scan
                 and self.fast_slack > 0 and flat.n == self.n_inner)
 
+    def _note_fast_program(self, profile) -> None:
+        """Publish the bounded chunk program's size gauges: unrolled
+        blocks after coalescing, scan groups, and the per-traversal
+        operation count (the launch-latency floor the bounded layout
+        exists to shrink) — landing in `--metrics` snapshots and BENCH
+        rows.  Tagged per engine like the other engine gauges
+        (_register_obs): two engines (DNA+AA instance, bench's several
+        K=4 instances) must not overwrite each other's program size."""
+        from examl_tpu.ops import fastpath
+        un, sc, total = fastpath.profile_stats(profile)
+        tag = "." + self._obs_tag
+        obs.gauge("engine.program_chunks" + tag, un)
+        obs.gauge("engine.scan_groups" + tag, sc)
+        obs.gauge("engine.dispatches_per_traversal" + tag, un + sc)
+        obs.gauge("engine.chunk_blocks_total" + tag, total)
+
     def _fast_fn_flat(self, profile, with_eval: bool):
-        """Jitted chunk program over PACKED structure + z arrays: each
-        chunk's window is sliced statically from the profile inside the
-        trace, so a dispatch carries 7 array leaves total instead of 7
-        per chunk.  Key leads with "fast" — same program family as the
-        legacy chunk path for the bank/watchdog accounting."""
+        """Jitted chunk program over the PACKED structure + z arrays:
+        each segment's window is sliced statically from the profile
+        inside the trace (scan groups reshape theirs to [glen, step]),
+        so a dispatch carries 7 array leaves total instead of 7 per
+        chunk.  The key IS the BUCKETED segment profile (not raw
+        per-chunk widths) — two topologies of similar shape mint the
+        same key and share one compiled program, which is the point of
+        width bucketing (tests/test_fastpath.py asserts the cache-hit
+        counters).  Key leads with "fast" — same program family as
+        before for the bank/watchdog accounting; the legacy entry-list
+        path dispatches through this same cache entry."""
         key = ("fast", profile, "flat", with_eval)
         fn = self.cache_get(key)
         if fn is not None:
             return fn
-        from examl_tpu.ops import fastpath
-
-        def build_chunks(base, lidx, ridx, lcode, rcode, zl, zr):
-            chunks = []
-            off = 0
-            for ci, (kind, W) in enumerate(profile):
-                sl = lambda a: jax.lax.slice_in_dim(a, off, off + W)
-                chunks.append(fastpath.FastChunk(
-                    kind, W, base[ci], sl(lidx), sl(ridx), sl(lcode),
-                    sl(rcode), sl(zl), sl(zr)))
-                off += W
-            return chunks
 
         def impl(clv, scaler, base, lidx, ridx, lcode, rcode, zl, zr,
                  dm, block_part, tips):
-            chunks = build_chunks(base, lidx, ridx, lcode, rcode, zl, zr)
-            return self._run_chunks_impl(dm, block_part, tips, clv,
-                                         scaler, chunks)
+            return self._run_segments_impl(
+                dm, block_part, tips, clv, scaler, profile, base, lidx,
+                ridx, lcode, rcode, zl, zr)
 
         def impl_eval(clv, scaler, base, lidx, ridx, lcode, rcode, zl,
                       zr, p_idx, q_idx, z, dm, block_part, weights,
                       tips):
-            chunks = build_chunks(base, lidx, ridx, lcode, rcode, zl, zr)
-            clv, scaler = self._run_chunks_impl(dm, block_part, tips,
-                                                clv, scaler, chunks)
+            clv, scaler = self._run_segments_impl(
+                dm, block_part, tips, clv, scaler, profile, base, lidx,
+                ridx, lcode, rcode, zl, zr)
             lnl = kernels.root_log_likelihood(
                 dm, block_part, weights, tips, clv, scaler, p_idx, q_idx,
                 z, self.num_parts, self.scale_exp, self.ntips, None)
@@ -1017,6 +1030,7 @@ class LikelihoodEngine:
             st = self._fast_structure(flat)
             zl, zr = fastpath.refresh_z(st, flat, self.num_branch_slots,
                                         self.dtype)
+        self._note_fast_program(st.profile)
         if p_num is None:
             fn = self._fast_fn_flat(st.profile, with_eval=False)
             self.clv, self.scaler = fn(
@@ -1049,26 +1063,55 @@ class LikelihoodEngine:
             return jax.lax.Precision.HIGHEST
         return self.fast_precision
 
-    def _run_chunks_impl(self, dm, block_part, tips, clv, scaler, chunks):
-        """Chunk execution on the engine-selected backend path (Pallas on
-        TPU, plain XLA elsewhere); the ONE dispatch point shared by the
-        jitted fast programs and external harnesses."""
+    def _chunk_applier(self, dm, block_part, tips):
+        """The per-chunk kernel on the engine-selected backend path
+        (fused Pallas on TPU, plain XLA elsewhere) — shared by the
+        unrolled reference executor and the bounded segment program."""
         if self.use_pallas:
             from examl_tpu.ops import pallas_newview
-            return pallas_newview.run_chunks(
-                dm, block_part, tips, clv, scaler, chunks,
-                self.scale_exp, precision=self.pallas_precision,
+            return pallas_newview.chunk_applier(
+                dm, block_part, tips, self.scale_exp,
+                precision=self.pallas_precision,
                 interpret=self.pallas_interpret)
         from examl_tpu.ops import fastpath
-        return fastpath.run_chunks(dm, block_part, tips, clv, scaler,
-                                   chunks, self.scale_exp,
-                                   self.fast_precision)
+        return fastpath.chunk_applier(dm, block_part, tips,
+                                      self.scale_exp,
+                                      self.fast_precision)
+
+    def _run_chunks_impl(self, dm, block_part, tips, clv, scaler, chunks):
+        """Unrolled chunk-list execution (traced); the reference
+        strategy external harnesses time (bench.py, perf lab)."""
+        apply = self._chunk_applier(dm, block_part, tips)
+        for ch in chunks:
+            clv, scaler = apply(clv, scaler, ch)
+        return clv, scaler
+
+    def _run_segments_impl(self, dm, block_part, tips, clv, scaler,
+                           profile, base, lidx, ridx, lcode, rcode, zl,
+                           zr):
+        """Bounded-program execution over the packed 7-leaf layout
+        (fastpath.run_segments): O(#segments) program ops — unrolled
+        hot chunks plus lax.scan long-tail groups — on the
+        engine-selected backend path."""
+        from examl_tpu.ops import fastpath
+        apply = self._chunk_applier(dm, block_part, tips)
+        return fastpath.run_segments(profile, base, lidx, ridx, lcode,
+                                     rcode, zl, zr, clv, scaler, apply)
 
     def run_chunks_traced(self, clv, scaler, chunks):
         """Traceable chunk execution for harnesses that build their own
         jit around the fast path (bench.py, perf lab)."""
         return self._run_chunks_impl(self.models, self.block_part,
                                      self.tips, clv, scaler, chunks)
+
+    def run_segments_traced(self, clv, scaler, sched):
+        """Traceable bounded-program execution from a FastSchedule (the
+        program the engine actually dispatches per full traversal) for
+        external harnesses (bench.py chunk tier)."""
+        return self._run_segments_impl(
+            self.models, self.block_part, self.tips, clv, scaler,
+            sched.profile, sched.base, sched.lidx, sched.ridx,
+            sched.lcode, sched.rcode, sched.zl, sched.zr)
 
     # -- whole-traversal Pallas path (ops/pallas_whole.py) ------------------
 
@@ -1307,33 +1350,6 @@ class LikelihoodEngine:
         N = len(plan.candidates)
         return np.asarray(lnls)[:N], np.asarray(es)[:N]
 
-    def _fast_fn(self, profile, with_eval: bool):
-        key = (profile, with_eval)
-        fn = self.cache_get(key)
-        if fn is not None:
-            return fn
-        from examl_tpu.ops import fastpath
-
-        def impl_eval(clv, scaler, chunk_data, p_idx, q_idx, z, dm,
-                      block_part, weights, tips):
-            chunks = [fastpath.FastChunk(kind, width, *cd)
-                      for (kind, width), cd in zip(profile, chunk_data)]
-            clv, scaler = self._run_chunks_impl(dm, block_part, tips, clv,
-                                                scaler, chunks)
-            lnl = kernels.root_log_likelihood(
-                dm, block_part, weights, tips, clv, scaler, p_idx, q_idx,
-                z, self.num_parts, self.scale_exp, self.ntips, None)
-            return clv, scaler, lnl
-
-        def impl(clv, scaler, chunk_data, dm, block_part, tips):
-            chunks = [fastpath.FastChunk(kind, width, *cd)
-                      for (kind, width), cd in zip(profile, chunk_data)]
-            return self._run_chunks_impl(dm, block_part, tips, clv, scaler,
-                                         chunks)
-
-        return self.cache_put(key, jax.jit(
-            impl_eval if with_eval else impl, donate_argnums=(0, 1)))
-
     # -- evaluation --------------------------------------------------------
 
     def _evaluate_impl(self, buf, scaler, aux, p_idx, q_idx, z, dm,
@@ -1424,14 +1440,13 @@ class LikelihoodEngine:
         if self.pallas_whole:
             return self._run_whole(entries, p_num, q_num, z)
         sched = self._fast_schedule(entries)
-        fn = self._fast_fn(sched.profile, with_eval=True)
-        data = tuple((c.base, c.lidx, c.ridx, c.lcode, c.rcode,
-                      c.zl, c.zr) for c in sched.chunks)
-
+        self._note_fast_program(sched.profile)
+        fn = self._fast_fn_flat(sched.profile, with_eval=True)
         zv = jnp.asarray(_z_slots(z, self.num_branch_slots),
                          dtype=self.dtype)
         self.clv, self.scaler, out = fn(
-            self.clv, self.scaler, data,
+            self.clv, self.scaler, sched.base, sched.lidx, sched.ridx,
+            sched.lcode, sched.rcode, sched.zl, sched.zr,
             jnp.int32(self._gidx_of(sched, p_num)),
             jnp.int32(self._gidx_of(sched, q_num)), zv, self.models,
             self.block_part, self.weights, self.tips)

@@ -1,4 +1,6 @@
-"""Fast full-traversal path: case-split wave chunks, MXU-shaped dots.
+"""Fast full-traversal path: case-split wave chunks, MXU-shaped dots,
+with a BOUNDED program: width bucketing, chunk coalescing and a scanned
+long tail keep the compiled chunk program at O(log n) operations.
 
 The TPU-native re-architecture of the reference's newview inner loops
 (ExaML `newviewGenericSpecial.c:1263-1497` dispatch over TIP_TIP /
@@ -6,9 +8,8 @@ TIP_INNER / INNER_INNER kernels, and the MIC backend's tip-product
 precompute `umpX`, `mic_native_dna.c:132-165`), driven by what the MXU
 and XLA actually reward (measured, tools/perf_lab.py):
 
-* Waves of independent entries are split by tip case and executed as a
-  statically unrolled sequence of chunks (no `lax.scan`), each chunk one
-  batched dot over its natural (power-of-two padded) width.
+* Waves of independent entries are split by tip case and executed as
+  chunks, each chunk one batched dot over its padded width.
 * The per-rate P application is folded into ONE block-diagonal
   [R*K, R*K] contraction per child — 4x fewer MXU row-streams than R
   separate [K, K] dots at identical numerics (the blocks are exact).
@@ -20,21 +21,56 @@ and XLA actually reward (measured, tools/perf_lab.py):
   in place — the `.at[].set` scatter inside scan was measured to copy
   the whole CLV buffer every step (half the runtime).
 
-The engine caches the jitted chunk-runner per wave profile AND the
-schedule's immutable structure per topology signature (`FastStructure`,
-built at array rate from a `FlatTraversal` by `build_structure`): only
-the per-chunk zl/zr branch arrays are rebuilt per call (`refresh_z`) —
-branch lengths change every traversal, the chunk layout only on
-topology changes.  A node->row map lets the scan path (partial
-traversals during search) and this path share one arena.  The legacy
-per-entry `build_schedule` remains as the uncached reference
-implementation (equivalence-tested, and still used for entry-list
-callers like bench tiers and bank warming).
+Program-size discipline (the BEAGLE lesson: library-scale phylogenetics
+lives or dies on operation scheduling cost, not FLOPs).  A naive
+schedule is one unrolled block per (wave, kind) chunk — ~1,500 blocks
+at 50k taxa, which costs XLA tens of minutes of CPU compile and pays a
+per-block launch-latency floor every traversal.  Three coordinated
+moves bound it:
+
+1. WIDTH BUCKETING — chunk widths quantize to a geometric ladder with a
+   floor (`MIN_WIDTH`, default 8) and a cap (`CHUNK_CAP`, default 1024;
+   wider chunks split into cap-width pieces).  The `(kind, width)`
+   alphabet is therefore small and FIXED, so profiles — and with them
+   jit keys and bank program families — are shared across topologies of
+   similar shape instead of being unique per tree.
+2. CHUNK COALESCING — runs of small same-kind chunks from adjacent
+   waves merge into one padded chunk when a vectorized dependency check
+   proves no merged entry reads a row the merged chunk itself writes
+   (entries within a wave are independent, so any split is valid; the
+   cross-wave merge is valid exactly when the check passes).  Arena
+   rows are assigned in final emission order, so merged writes stay
+   contiguous `dynamic_update_slice`s.
+3. SCANNED LONG TAIL — maximal runs of chunks with an identical
+   bucketed step shape (same `(kind, width)` for head runs produced by
+   cap-splitting; same per-wave `((kind, width), ...)` signature for
+   the narrow tail waves, absent kinds normalized to width-`MIN_WIDTH`
+   padding sub-chunks) collapse into ONE `lax.scan` over stacked chunk
+   arrays.  Scan lengths bucket geometrically; padding steps REPLAY the
+   run's final step, which is idempotent (a chunk reads only rows
+   written strictly before it and rewrites its own rows with identical
+   values), so no scratch arithmetic leaks into real rows.
+
+The resulting `profile` is a tuple of segments — `("u", kind, width)`
+for an unrolled block, `("s", glen, ((kind, width), ...))` for a scan
+group — and IS the jit key: program length is O(#segments) ~ O(log n)
+(measured: 50k taxa, 1,511 raw chunks -> ~70 unrolled blocks + ~35 scan
+groups), and execution order equals wave order chunk for chunk, so the
+bounded program's lnL is bit-identical to the unbounded unroll.
+
+`build_structure` (vectorized, from a `FlatTraversal`) and the legacy
+per-entry `build_schedule` both produce the IDENTICAL bounded layout
+(equivalence contract, tests/test_scale.py + tests/test_fastpath.py);
+the engine caches the immutable structure per topology signature and
+refreshes only the packed z arrays per call (`refresh_z`).
+`EXAML_BOUNDED_CHUNKS=0` restores the legacy one-block-per-chunk
+layout (escape hatch + the equivalence-test reference).
 """
 
 from __future__ import annotations
 
-from typing import Dict, List, NamedTuple, Tuple
+import os
+from typing import Dict, List, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -42,6 +78,55 @@ import numpy as np
 
 from examl_tpu.ops import kernels
 from examl_tpu.tree.topology import Tree, TraversalEntry
+from examl_tpu.utils import bucket_len, next_pow2
+
+# -- bounded-layout knobs ----------------------------------------------------
+# The ladder alphabet is {MIN_WIDTH, 2*MIN_WIDTH, ..., CHUNK_CAP}: small and
+# fixed, so two topologies of similar shape produce the SAME profile and
+# share one compiled program (and one bank family / persistent-cache entry).
+
+MIN_WIDTH = 8        # width floor (EXAML_CHUNK_MIN_WIDTH)
+CHUNK_CAP = 1024     # width cap; wider chunks split (EXAML_CHUNK_CAP)
+TAIL_WIDTH = 64      # waves whose chunks all bucket <= this join the
+                     # scanned tail (EXAML_CHUNK_TAIL_WIDTH)
+MIN_SCAN = 4         # shorter runs stay unrolled (replay padding would
+                     # dominate them)
+
+
+def _env_int(name: str, default: int) -> int:
+    v = os.environ.get(name)
+    if not v:
+        return default
+    try:
+        return max(1, int(v))
+    except ValueError:
+        return default
+
+
+def _knobs() -> Tuple[int, int, int]:
+    mw = next_pow2(_env_int("EXAML_CHUNK_MIN_WIDTH", MIN_WIDTH))
+    cap = max(mw, next_pow2(_env_int("EXAML_CHUNK_CAP", CHUNK_CAP)))
+    tail = max(mw, next_pow2(_env_int("EXAML_CHUNK_TAIL_WIDTH",
+                                      TAIL_WIDTH)))
+    return mw, cap, tail
+
+
+def bounded_default() -> bool:
+    """Bounded layout unless EXAML_BOUNDED_CHUNKS=0 (escape hatch; also
+    the reference layout for the equivalence tests)."""
+    return os.environ.get("EXAML_BOUNDED_CHUNKS", "") != "0"
+
+
+def slack_rows(ntips: int) -> int:
+    """Arena slack rows the bounded layout needs: headroom for padded
+    chunk writes past the real rows AND the dedicated pad region the
+    scanned tail's width-MIN_WIDTH padding sub-chunks write (base = n).
+    Derived from the LIVE knobs so an env-tuned EXAML_CHUNK_MIN_WIDTH
+    is provisioned for, not crashed on (every build still asserts
+    max_write against the arena)."""
+    mw, _cap, _tailw = _knobs()
+    floor = 2 * mw
+    return min(max(64, floor), max(next_pow2(ntips), floor))
 
 
 class FastChunk(NamedTuple):
@@ -61,47 +146,435 @@ class FastChunk(NamedTuple):
     zr: jax.Array           # [W, C]
 
 
-class FastSchedule(NamedTuple):
-    chunks: Tuple[FastChunk, ...]
-    row_of: Dict[int, int]      # node number -> arena row
-    profile: Tuple[Tuple[int, int], ...]   # ((kind, width), ...) jit key
-    num_rows: int               # rows actually holding real entries
-    max_write: int              # highest row index written + 1 (incl. spill)
-
-
 class FastStructure(NamedTuple):
     """The IMMUTABLE half of a fast-path schedule: everything that is a
-    function of topology + traversal root only (chunk kinds/widths,
-    child index/code arrays, the arena row map) — cacheable across the
-    branch-length-only traversals that dominate model optimization and
-    repeated full evaluations.  The cheap DYNAMIC half (per-chunk
-    zl/zr) is rebuilt per call by `refresh_z` through the stored
-    entry->slot permutation.
+    function of topology + traversal root only (segment profile, chunk
+    widths/bases, packed child index/code arrays, the arena row map) —
+    cacheable across the branch-length-only traversals that dominate
+    model optimization and repeated full evaluations.  The cheap
+    DYNAMIC half (packed per-slot zl/zr) is rebuilt per call by
+    `refresh_z` through the stored entry->slot map.
 
     Child/code arrays are stored PACKED along one padded slot axis
     (device-resident, transferred once); the jitted program slices each
-    chunk's window statically from the profile, so a cached dispatch
-    ships only the two fresh z arrays to the device."""
-    profile: Tuple[Tuple[int, int], ...]   # ((kind, width), ...) jit key
+    segment's window statically from the profile (scan groups reshape
+    theirs to [glen, step_width]), so a cached dispatch ships only the
+    two fresh z arrays to the device.
+
+    `profile` is the BUCKETED segment tuple (see module docstring), not
+    raw per-chunk widths — it is the engine's jit-cache key, so two
+    different topologies with the same bucketed profile share one
+    compiled program (tests/test_fastpath.py proves the cache hit)."""
+    profile: Tuple[tuple, ...]  # segment tuple: the jit key
     base: jax.Array             # [n_chunks] int32: first arena row written
     lidx: jax.Array             # [P] packed left-child arena rows
     ridx: jax.Array             # [P]
     lcode: jax.Array            # [P] packed 0-based tip indices
     rcode: jax.Array            # [P]
     row_of: np.ndarray          # [2*ntips-1] node number -> row (-1 tips)
-    z_src: np.ndarray           # [P] flat-entry index per slot (-1 pad)
+    z_src: np.ndarray           # [P] flat-entry index per slot (-1 pad;
+                                #     replay slots repeat their source)
     z_swap: np.ndarray          # [P] slot's children were canonicalized
     num_rows: int
     max_write: int
 
 
-def build_structure(flat, ntips: int) -> FastStructure:
+class FastSchedule:
+    """Entry-list twin of `FastStructure` (legacy per-entry builder):
+    the same packed layout plus the packed z arrays, and a lazily
+    materialized per-chunk `FastChunk` list for harnesses that unroll
+    chunks themselves (bench tiers, the Pallas equivalence tests).
+    `profile` is the bucketed segment tuple — identical to
+    `build_structure`'s for the same traversal (equivalence contract).
+    """
+
+    __slots__ = ("profile", "row_of", "num_rows", "max_write",
+                 "base", "lidx", "ridx", "lcode", "rcode", "zl", "zr",
+                 "_host", "_chunks")
+
+    def __init__(self, profile, row_of, num_rows, max_write, dev, host):
+        self.profile = profile
+        self.row_of: Dict[int, int] = row_of
+        self.num_rows = num_rows
+        self.max_write = max_write
+        (self.base, self.lidx, self.ridx, self.lcode, self.rcode,
+         self.zl, self.zr) = dev
+        self._host = host
+        self._chunks: Optional[Tuple[FastChunk, ...]] = None
+
+    @property
+    def chunks(self) -> Tuple[FastChunk, ...]:
+        """Materialized per-chunk list in execution order (includes the
+        replay/padding chunks of scan groups, so running it unrolled is
+        bit-identical to the segment program).  Built lazily — the
+        engine's jitted programs use the packed arrays instead."""
+        if self._chunks is None:
+            base_h, li, ri, lc, rc, zl, zr = self._host
+            views = []
+            metas = []
+            off = cidx = 0
+            for kind, W in iter_profile_chunks(self.profile):
+                views += [li[off:off + W], ri[off:off + W],
+                          lc[off:off + W], rc[off:off + W],
+                          zl[off:off + W], zr[off:off + W]]
+                metas.append((kind, W, np.int32(base_h[cidx])))
+                off += W
+                cidx += 1
+            dev = iter(jax.device_put(
+                [m[2] for m in metas] + views))
+            bases = [next(dev) for _ in metas]
+            self._chunks = tuple(
+                FastChunk(kind, W, b, next(dev), next(dev), next(dev),
+                          next(dev), next(dev), next(dev))
+                for (kind, W, _), b in zip(metas, bases))
+        return self._chunks
+
+
+# -- profile helpers ---------------------------------------------------------
+
+
+def iter_profile_chunks(profile):
+    """Yield (kind, width) for every chunk in execution order, scan
+    groups expanded step-major (incl. replay steps)."""
+    for seg in profile:
+        if seg[0] == "u":
+            yield seg[1], seg[2]
+        else:
+            _, glen, subs = seg
+            for _ in range(glen):
+                for k, w in subs:
+                    yield k, w
+
+
+def profile_stats(profile) -> Tuple[int, int, int]:
+    """(unrolled_blocks, scan_groups, total_chunks) of a profile —
+    unrolled_blocks + scan_groups is the program's operation count (the
+    launch-latency floor per traversal); total_chunks counts every
+    chunk incl. scan steps (the raw work-unit count)."""
+    un = sum(1 for s in profile if s[0] == "u")
+    sc = sum(1 for s in profile if s[0] == "s")
+    total = sum(1 for _ in iter_profile_chunks(profile))
+    return un, sc, total
+
+
+def profile_slots(profile) -> int:
+    """Total packed slot count P of a profile."""
+    return sum(w for _, w in iter_profile_chunks(profile))
+
+
+# -- layout planning ---------------------------------------------------------
+
+
+class _Chunk:
+    """Planner-internal chunk record (host only)."""
+
+    __slots__ = ("kind", "W", "spans", "real", "pad", "replay_of",
+                 "base", "slot")
+
+    def __init__(self, kind, W, spans, pad=False, replay_of=None):
+        self.kind = kind
+        self.W = W
+        self.spans = spans          # [(lo, hi)] into sorted-entry order
+        self.real = sum(hi - lo for lo, hi in spans)
+        self.pad = pad              # writes only slack rows
+        self.replay_of = replay_of  # index into the final chunk list
+        self.base = -1
+        self.slot = -1
+
+
+class _Layout(NamedTuple):
+    profile: Tuple[tuple, ...]
+    chunks: List[_Chunk]        # final execution order (incl. pads/replays)
+    P: int                      # total packed slots
+    max_write: int
+
+
+def _bucket_w(s: int, mw: int) -> int:
+    return max(mw, next_pow2(s))
+
+
+def _plan_layout(kinds: np.ndarray, sizes: np.ndarray, gwave: np.ndarray,
+                 starts: np.ndarray, child_key: np.ndarray, n: int,
+                 bounded: bool) -> _Layout:
+    """Plan the chunk/segment layout from the (wave, kind)-sorted group
+    table.  `child_key[g]` is the max (wave*3+kind) sort key over group
+    g's inner children's defining entries (-1 when all children are
+    tips/external) — the vectorized dependency oracle for coalescing.
+
+    Unbounded (legacy) mode: one unrolled chunk per group, width
+    pow2(size) with no floor — byte-for-byte the historical layout."""
+    G = len(kinds)
+    if not bounded:
+        chunks = [_Chunk(int(kinds[g]), next_pow2(int(sizes[g])),
+                         [(int(starts[g]), int(starts[g] + sizes[g]))])
+                  for g in range(G)]
+        profile = tuple(("u", c.kind, c.W) for c in chunks)
+        return _finish_layout(profile, chunks, n)
+
+    mw, cap, tailw = _knobs()
+
+    # -- 1. coalescing: merge a small group into the newest earlier
+    # same-kind group when every inner child of the candidate was
+    # computed strictly before the target's position (original sort
+    # keys upper-bound post-merge positions, so the check is
+    # conservative-safe) and the merged chunk stays small.
+    class _Rec:
+        __slots__ = ("kind", "wave", "size", "spans", "key")
+
+        def __init__(self, g):
+            self.kind = int(kinds[g])
+            self.wave = int(gwave[g])
+            self.size = int(sizes[g])
+            self.spans = [(int(starts[g]), int(starts[g] + sizes[g]))]
+            self.key = self.wave * 3 + self.kind
+
+    recs: List[_Rec] = []
+    open_of: Dict[int, _Rec] = {}
+    for g in range(G):
+        k = int(kinds[g])
+        t = open_of.get(k)
+        if (t is not None and t.size + int(sizes[g]) <= tailw
+                and int(child_key[g]) < t.key):
+            t.size += int(sizes[g])
+            t.spans.append((int(starts[g]), int(starts[g] + sizes[g])))
+            continue
+        r = _Rec(g)
+        recs.append(r)
+        open_of[k] = r
+
+    # -- 2. per-wave emission: head waves cap-split into ladder pieces,
+    # tail waves normalize to a per-wave signature with width-mw padding
+    # sub-chunks for absent (previously seen) kinds.
+    by_wave: Dict[int, List[_Rec]] = {}
+    for r in recs:
+        by_wave.setdefault(r.wave, []).append(r)
+
+    def split_spans(spans, take):
+        """Cut `take` entries off the front of a span list."""
+        out, rest = [], []
+        need = take
+        for lo, hi in spans:
+            if need <= 0:
+                rest.append((lo, hi))
+            elif hi - lo <= need:
+                out.append((lo, hi))
+                need -= hi - lo
+            else:
+                out.append((lo, lo + need))
+                rest.append((lo + need, hi))
+                need = 0
+        return out, rest
+
+    stream: List[tuple] = []    # ("h", [chunk]) | ("t", sig, [chunks])
+    seen = set()
+    for wave in sorted(by_wave):
+        wrecs = sorted(by_wave[wave], key=lambda r: r.kind)
+        tail = all(_bucket_w(r.size, mw) <= tailw for r in wrecs)
+        if tail:
+            step = []
+            have = {r.kind: r for r in wrecs}
+            for k in (0, 1, 2):
+                r = have.get(k)
+                if r is not None:
+                    step.append(_Chunk(k, _bucket_w(r.size, mw), r.spans))
+                elif k in (1, 2) and k in seen:
+                    step.append(_Chunk(k, mw, [], pad=True))
+            seen.update(have)
+            sig = tuple((c.kind, c.W) for c in step)
+            stream.append(("t", sig, step))
+        else:
+            out = []
+            for r in wrecs:
+                seen.add(r.kind)
+                spans, size = r.spans, r.size
+                while size > cap:
+                    head, spans = split_spans(spans, cap)
+                    out.append(_Chunk(r.kind, cap, head))
+                    size -= cap
+                out.append(_Chunk(r.kind, _bucket_w(size, mw), spans))
+            stream.append(("h", out))
+
+    # -- 3. segmentation: maximal runs of an identical step shape become
+    # one lax.scan; scan lengths bucket geometrically with replay
+    # padding (idempotent re-execution of the final step).
+    profile: List[tuple] = []
+    chunks: List[_Chunk] = []
+
+    def emit_run(sig, steps):
+        glen = len(steps)
+        if glen < MIN_SCAN:
+            for step in steps:
+                for c in step:
+                    if not c.pad:       # unrolled pads are pure waste
+                        profile.append(("u", c.kind, c.W))
+                        chunks.append(c)
+            return
+        blen = bucket_len(glen)
+        profile.append(("s", blen, sig))
+        for step in steps:
+            chunks.extend(step)
+        ns = len(sig)
+        last = len(chunks) - ns
+        for _ in range(blen - glen):
+            for j in range(ns):
+                src = last + j
+                chunks.append(_Chunk(chunks[src].kind, chunks[src].W,
+                                     chunks[src].spans,
+                                     pad=chunks[src].pad,
+                                     replay_of=src))
+
+    run_sig: Optional[tuple] = None
+    run_steps: List[List[_Chunk]] = []
+
+    def flush():
+        nonlocal run_sig, run_steps
+        if run_steps:
+            emit_run(run_sig, run_steps)
+        run_sig, run_steps = None, []
+
+    for item in stream:
+        if item[0] == "h":
+            for c in item[1]:
+                sig = ((c.kind, c.W),)
+                if sig != run_sig:
+                    flush()
+                    run_sig = sig
+                run_steps.append([c])
+        else:
+            _, sig, step = item
+            if sig != run_sig:
+                flush()
+                run_sig = sig
+            run_steps.append(step)
+    flush()
+
+    return _finish_layout(tuple(profile), chunks, n)
+
+
+def _finish_layout(profile, chunks, n: int) -> _Layout:
+    """Assign arena rows (final emission order; pads write the slack
+    region at row n, replays rewrite their source rows) and packed slot
+    offsets; compute max_write for the engine's arena-capacity check."""
+    row = 0
+    slot = 0
+    max_write = 0
+    for c in chunks:
+        c.slot = slot
+        slot += c.W
+        if c.replay_of is not None:
+            c.base = chunks[c.replay_of].base
+        elif c.pad:
+            c.base = n
+        else:
+            c.base = row
+            row += c.real
+        max_write = max(max_write, c.base + c.W)
+    assert row == n, (row, n)
+    return _Layout(profile=profile, chunks=chunks, P=slot,
+                   max_write=max_write)
+
+
+def _layout_from_arrays(wave_id, el, er, lt, rt, child_nodes_key, n,
+                        bounded):
+    """Shared planner front-end: group the (wave, kind)-sorted entries
+    and plan.  Returns (layout, order, skey-derived group table pieces)
+    where `order` is the (wave, kind) stable sort permutation."""
+    if n == 0:
+        return (_Layout(profile=(), chunks=[], P=0, max_write=0),
+                np.empty(0, np.int64))
+    kind = 2 - (lt.astype(np.int64) + rt.astype(np.int64))
+    skey_all = wave_id * 3 + kind
+    order = np.argsort(skey_all, kind="stable")
+    skey = skey_all[order]
+    starts = np.flatnonzero(np.r_[True, skey[1:] != skey[:-1]])
+    sizes = np.diff(np.r_[starts, n])
+    kinds = (skey[starts] % 3).astype(np.int64)
+    gwave = (skey[starts] // 3).astype(np.int64)
+    # Dependency oracle: per sorted entry, the max sort key over its
+    # inner children's defining entries (tips/external -> -1), reduced
+    # per group.
+    ck = np.maximum(child_nodes_key[el[order]],
+                    child_nodes_key[er[order]])
+    child_key = (np.maximum.reduceat(ck, starts) if n
+                 else np.empty(0, np.int64))
+    layout = _plan_layout(kinds, sizes, gwave, starts, child_key, n,
+                          bounded)
+    return layout, order
+
+
+def _pack_structure(layout: _Layout, order, el, er, lt, rt, swap, parent,
+                    row_map_size: int):
+    """Fill the packed per-slot arrays from a layout: a scatter per real
+    chunk span (vectorized over entries), then slot-window copies for
+    the replay chunks.  Returns host arrays."""
+    n = order.shape[0]
+    P = layout.P
+    # Final entry order: concatenation of real-chunk spans (emission
+    # order) — rows 0..n-1 in exactly this order.
+    spans = [(lo, hi) for c in layout.chunks if c.replay_of is None
+             for (lo, hi) in c.spans]
+    if spans:
+        pos = np.concatenate([np.arange(lo, hi) for lo, hi in spans])
+    else:
+        pos = np.empty(0, np.int64)
+    assert pos.shape[0] == n
+    final = order[pos]                  # indices into the ORIGINAL entries
+    row_of = np.full(row_map_size, -1, dtype=np.int64)
+    row_of[parent[final]] = np.arange(n)
+    # Destination slot of each final-order entry.
+    dst = np.empty(n, np.int64)
+    off = 0
+    for c in layout.chunks:
+        if c.replay_of is not None:
+            continue
+        dst[off:off + c.real] = c.slot + np.arange(c.real)
+        off += c.real
+    el_f = el[final]
+    er_f = er[final]
+    lt_f = lt[final] | rt[final]        # post-swap: left is tip (kind 0/1)
+    rt_f = lt[final] & rt[final]        # post-swap: right is tip (kind 0)
+    # Every inner child must be defined by some entry in the traversal:
+    # a -1 row would silently gather the scratch row (the loud
+    # replacement for the old per-entry builder's KeyError on partial
+    # entry lists, which the fast builders do not support).
+    if (((~lt_f) & (row_of[el_f] < 0))
+            | ((~rt_f) & (row_of[er_f] < 0))).any():
+        raise KeyError("traversal entries reference inner children no "
+                       "entry computes (partial entry lists are not "
+                       "supported by the fast-path schedule builders)")
+    lidx = np.zeros(P, np.int32)
+    ridx = np.zeros(P, np.int32)
+    lcode = np.zeros(P, np.int32)
+    rcode = np.zeros(P, np.int32)
+    z_src = np.full(P, -1, np.int64)
+    z_swap = np.zeros(P, bool)
+    lidx[dst] = np.where(lt_f, 0, row_of[el_f])
+    ridx[dst] = np.where(rt_f, 0, row_of[er_f])
+    lcode[dst] = np.where(lt_f, el_f - 1, 0)
+    rcode[dst] = np.where(rt_f, er_f - 1, 0)
+    z_src[dst] = final
+    z_swap[dst] = swap[final]
+    for c in layout.chunks:             # replay steps copy their source
+        if c.replay_of is None:
+            continue
+        s = layout.chunks[c.replay_of].slot
+        for arr in (lidx, ridx, lcode, rcode, z_src, z_swap):
+            arr[c.slot:c.slot + c.W] = arr[s:s + c.W]
+    base = np.asarray([c.base for c in layout.chunks], np.int32)
+    return row_of, base, lidx, ridx, lcode, rcode, z_src, z_swap, dst
+
+
+def build_structure(flat, ntips: int,
+                    bounded: Optional[bool] = None) -> FastStructure:
     """Vectorized schedule-structure build from a FlatTraversal: the
     per-entry Python loop of `build_schedule` replaced by numpy sort/
     scatter over the whole traversal (this is what makes a 120k-taxon
-    schedule build array-rate).  Produces the identical chunk layout —
-    same (wave, kind) grouping, same pow2 widths, same row assignment
-    discipline — as `build_schedule` on the same wave order."""
+    schedule build array-rate).  Produces the identical bounded chunk
+    layout — same bucketing, coalescing, scan grouping, same row
+    assignment discipline — as `build_schedule` on the same wave order
+    (the equivalence contract both builders must keep)."""
+    if bounded is None:
+        bounded = bounded_default()
     n = flat.n
     left = flat.left
     right = flat.right
@@ -112,54 +585,28 @@ def build_structure(flat, ntips: int) -> FastStructure:
     swap = (~lt) & rt                     # canonicalize: tip child left
     el = np.where(swap, right, left)
     er = np.where(swap, left, right)
-    kind = 2 - (lt.astype(np.int64) + rt.astype(np.int64))
-    order = np.argsort(wave_id * 3 + kind, kind="stable")
-    # Row of an entry = its position in (wave, kind)-sorted order: waves
-    # pack consecutively, kind groups advance by their REAL size (pow2
-    # spill overwrites later rows before anything reads them).
-    row_of = np.full(2 * ntips - 1, -1, dtype=np.int64)
-    row_of[flat.parent[order]] = np.arange(n)
-    skey = (wave_id * 3 + kind)[order]
-    starts = np.flatnonzero(np.r_[True, skey[1:] != skey[:-1]])
-    sizes = np.diff(np.r_[starts, n])
-    widths = np.asarray([_pow2(int(g)) for g in sizes], dtype=np.int64)
-    poff = np.concatenate([[0], np.cumsum(widths)[:-1]])
-    P = int(widths.sum())
-    kinds = kind[order][starts]
-    profile = tuple((int(k), int(w)) for k, w in zip(kinds, widths))
-    # Packed slot layout: destination of sorted entry i.
-    dst = (np.repeat(poff, sizes)
-           + np.arange(n) - np.repeat(starts, sizes))
-    el_s = el[order]
-    er_s = er[order]
-    lt_s = (lt | rt)[order]               # post-swap: left tip (kind 0/1)
-    rt_s = (lt & rt)[order]               # post-swap: right tip (kind 0)
-    lidx = np.zeros(P, np.int32)
-    ridx = np.zeros(P, np.int32)
-    lcode = np.zeros(P, np.int32)
-    rcode = np.zeros(P, np.int32)
-    z_src = np.full(P, -1, np.int64)
-    z_swap = np.zeros(P, bool)
-    lidx[dst] = np.where(lt_s, 0, row_of[el_s])
-    ridx[dst] = np.where(rt_s, 0, row_of[er_s])
-    lcode[dst] = np.where(lt_s, el_s - 1, 0)
-    rcode[dst] = np.where(rt_s, er_s - 1, 0)
-    z_src[dst] = order
-    z_swap[dst] = swap[order]
-    dev = jax.device_put([starts.astype(np.int32), lidx, ridx, lcode,
-                          rcode])
-    return FastStructure(profile=profile, base=dev[0], lidx=dev[1],
+    kind = 2 - ((left <= ntips).astype(np.int64)
+                + (right <= ntips).astype(np.int64))
+    node_key = np.full(2 * ntips - 1, -1, dtype=np.int64)
+    node_key[flat.parent] = wave_id * 3 + kind
+    layout, order = _layout_from_arrays(
+        wave_id, el, er, lt, rt, node_key, n, bounded)
+    (row_of, base, lidx, ridx, lcode, rcode, z_src, z_swap,
+     _dst) = _pack_structure(layout, order, el, er, lt, rt, swap,
+                             flat.parent, 2 * ntips - 1)
+    dev = jax.device_put([base, lidx, ridx, lcode, rcode])
+    return FastStructure(profile=layout.profile, base=dev[0], lidx=dev[1],
                          ridx=dev[2], lcode=dev[3], rcode=dev[4],
                          row_of=row_of, z_src=z_src, z_swap=z_swap,
-                         num_rows=n,
-                         max_write=int((starts + widths).max()) if n else 0)
+                         num_rows=n, max_write=layout.max_write)
 
 
 def refresh_z(st: FastStructure, flat, num_slots: int, dtype):
     """The DYNAMIC half of a cached schedule: permute the traversal's
     branch-length vectors into packed chunk-slot order (canonical swap
-    applied, padding slots at z=1) — pure numpy fancy indexing, the
-    only per-call host work on a schedule-cache hit."""
+    applied; padding slots at z=1, replay slots repeating their source
+    entry's z) — pure numpy fancy indexing, the only per-call host work
+    on a schedule-cache hit."""
     zl_f = flat.zl
     zr_f = flat.zr
     if zl_f.shape[1] != num_slots:
@@ -177,115 +624,98 @@ def refresh_z(st: FastStructure, flat, num_slots: int, dtype):
     return jax.device_put([np.asarray(zl, dtype), np.asarray(zr, dtype)])
 
 
-def _pow2(n: int) -> int:
-    p = 1
-    while p < n:
-        p *= 2
-    return p
+def _z_matrix(zs: List[tuple], num_slots: int) -> np.ndarray:
+    """[n, num_slots] branch-length matrix from per-entry z tuples
+    (vectorized for the uniform-length cases that dominate)."""
+    from examl_tpu.utils import z_slots
+    n = len(zs)
+    if n == 0:
+        return np.ones((0, num_slots))
+    ln = len(zs[0])
+    if all(len(z) == ln for z in zs):
+        arr = np.asarray(zs, dtype=np.float64)
+        if ln == num_slots:
+            return arr
+        if ln == 1:
+            return np.broadcast_to(arr, (n, num_slots)).copy()
+        if ln > num_slots:
+            return arr[:, :num_slots].copy()
+    return np.stack([z_slots(z, num_slots) for z in zs])
 
 
 def build_schedule(entries: List[TraversalEntry], ntips: int,
-                   num_slots: int, dtype, base_row: int = 0,
-                   row_of_existing: Dict[int, int] | None = None,
-                   ) -> FastSchedule:
-    """Wave-schedule entries into case-split chunks writing rows
-    base_row, base_row+1, ... in wave order.
-
-    row_of_existing resolves inner children computed OUTSIDE these
-    entries (partial traversals); full traversals need none.
-    """
-    from examl_tpu.utils import z_slots
-
+                   num_slots: int, dtype,
+                   bounded: Optional[bool] = None) -> FastSchedule:
+    """Wave-schedule entries into the bounded chunk layout (see module
+    docstring), packed along one slot axis.  The uncached reference
+    builder: equivalence-tested against `build_structure`, and still
+    used by entry-list callers (bench tiers, bank warming)."""
+    if bounded is None:
+        bounded = bounded_default()
     waves = Tree.schedule_waves(entries)
-    row_of: Dict[int, int] = {}
-    lookup = row_of_existing or {}
-
-    def child_row(num: int) -> int:
-        if num in row_of:
-            return row_of[num]
-        return lookup[num]
-
-    host_chunks: List[tuple] = []
-    rows = base_row
-    max_write = base_row
-    for wave in waves:
-        def ntip(e):
-            return (e.left <= ntips) + (e.right <= ntips)
-        groups = ([e for e in wave if ntip(e) == 2],
-                  [e for e in wave if ntip(e) == 1],
-                  [e for e in wave if ntip(e) == 0])
-        base = rows
-        for wi, e in enumerate(groups[0] + groups[1] + groups[2]):
-            row_of[e.parent] = base + wi
-        off = 0
-        for kind, grp in ((0, groups[0]), (1, groups[1]), (2, groups[2])):
-            if not grp:
-                continue
-            W = _pow2(len(grp))
-            lidx = np.zeros(W, np.int32)
-            ridx = np.zeros(W, np.int32)
-            lcode = np.zeros(W, np.int32)
-            rcode = np.zeros(W, np.int32)
-            zl = np.ones((W, num_slots))
-            zr = np.ones((W, num_slots))
-            one_slot = num_slots == 1
-            for wi, e in enumerate(grp):
-                lt, rt = e.left <= ntips, e.right <= ntips
-                ezl, ezr = e.zl, e.zr
-                el, er = e.left, e.right
-                if not lt and rt:      # canonicalize: tip child on the left
-                    el, er, ezl, ezr = er, el, ezr, ezl
-                    lt, rt = True, False
-                lidx[wi] = 0 if lt else child_row(el)
-                ridx[wi] = 0 if rt else child_row(er)
-                lcode[wi] = el - 1 if lt else 0
-                rcode[wi] = er - 1 if rt else 0
-                if one_slot:           # hot path: z_slots dominates at 50k+
-                    zl[wi, 0] = ezl[0]
-                    zr[wi, 0] = ezr[0]
-                else:
-                    zl[wi] = z_slots(ezl, num_slots)
-                    zr[wi] = z_slots(ezr, num_slots)
-            host_chunks.append(
-                (kind, W, np.int32(base + off), lidx, ridx, lcode, rcode,
-                 np.asarray(zl, dtype), np.asarray(zr, dtype)))
-            max_write = max(max_write, base + off + W)
-            off += len(grp)
-        rows = base + off
-    # ONE batched host->device transfer for every chunk's arrays: at 50k
-    # taxa this is ~1,500 chunks x 7 arrays, and per-array jnp.asarray
-    # device_puts dominated the whole schedule build (~1.5 s of 2.3 s);
-    # the batched put is ~30 ms.
-    flat = [a for hc in host_chunks for a in hc[2:]]
-    dev = iter(jax.device_put(flat))
-    chunks = [FastChunk(kind=kind, width=W, base=next(dev),
-                        lidx=next(dev), ridx=next(dev), lcode=next(dev),
-                        rcode=next(dev), zl=next(dev), zr=next(dev))
-              for (kind, W, *_rest) in host_chunks]
-    profile = tuple((c.kind, c.width) for c in chunks)
-    return FastSchedule(chunks=tuple(chunks), row_of=row_of,
-                        profile=profile, num_rows=rows, max_write=max_write)
+    n = len(entries)
+    wave_entries = [e for w in waves for e in w]
+    wave_id = np.repeat(np.arange(len(waves), dtype=np.int64),
+                        [len(w) for w in waves])
+    parent = np.fromiter((e.parent for e in wave_entries), np.int64, n)
+    left = np.fromiter((e.left for e in wave_entries), np.int64, n)
+    right = np.fromiter((e.right for e in wave_entries), np.int64, n)
+    zl_e = _z_matrix([e.zl for e in wave_entries], num_slots)
+    zr_e = _z_matrix([e.zr for e in wave_entries], num_slots)
+    lt = left <= ntips
+    rt = right <= ntips
+    swap = (~lt) & rt
+    el = np.where(swap, right, left)
+    er = np.where(swap, left, right)
+    kind = 2 - (lt.astype(np.int64) + rt.astype(np.int64))
+    nk = max(2 * ntips - 1, int(max(el.max(), er.max())) + 1) if n else 1
+    node_key = np.full(nk, -1, dtype=np.int64)
+    node_key[parent] = wave_id * 3 + kind
+    layout, order = _layout_from_arrays(
+        wave_id, el, er, lt, rt, node_key, n, bounded)
+    (row_arr, base, lidx, ridx, lcode, rcode, z_src, z_swap,
+     dst) = _pack_structure(layout, order, el, er, lt, rt, swap,
+                            parent, nk)
+    P = layout.P
+    zl = np.ones((P, num_slots))
+    zr = np.ones((P, num_slots))
+    ok = z_src >= 0
+    src = z_src[ok]
+    sw = z_swap[ok, None]
+    zl[ok] = np.where(sw, zr_e[src], zl_e[src])
+    zr[ok] = np.where(sw, zl_e[src], zr_e[src])
+    zl = np.asarray(zl, dtype)
+    zr = np.asarray(zr, dtype)
+    row_of = {int(num): int(r) for num, r in enumerate(row_arr)
+              if r >= 0}
+    host = (base, lidx, ridx, lcode, rcode, zl, zr)
+    # ONE batched host->device transfer for the whole packed layout
+    # (per-array device_puts dominated the 50k schedule build).
+    dev = jax.device_put(list(host))
+    return FastSchedule(profile=layout.profile, row_of=row_of,
+                        num_rows=n, max_write=layout.max_write,
+                        dev=dev, host=host)
 
 
-def run_chunks(models: kernels.DeviceModels, block_part: jax.Array,
-               tips: kernels.TipState, clv: jax.Array, scaler: jax.Array,
-               chunks, scale_exp: int, precision) -> Tuple[jax.Array, jax.Array]:
-    """Execute the chunk sequence (traced; shapes static per profile).
+# -- execution ---------------------------------------------------------------
 
-    clv is [rows, B, lane, R, K]; writes spill up to width-1 junk rows
-    past each chunk's real entries — the arena reserves slack for the
-    final chunk and intermediate spill is overwritten by later chunks
-    before anything reads it.
-    """
-    rows, B, lane, R, K = clv.shape
-    RK = R * K
+
+def chunk_applier(models: kernels.DeviceModels, block_part: jax.Array,
+                  tips: kernels.TipState, scale_exp: int, precision):
+    """The single-chunk kernel body (traced): P-build + child
+    contractions + product + rescale + contiguous arena write.  Shared
+    by the unrolled blocks, the lax.scan group bodies, and the
+    reference `run_chunks` loop, so every execution strategy performs
+    the identical arithmetic."""
     M = models.eign.shape[0]
     C = tips.table.shape[0]
     cdt = tips.table.dtype        # COMPUTE dtype; the arena may store
+    R = models.gamma_rates.shape[1]
     eyeR = jnp.eye(R, dtype=cdt)  # narrower (bf16 tier, EXAML_CLV_DTYPE)
     HI = jax.lax.Precision.HIGHEST
+    minlik, two_e, _ = kernels.scale_constants(cdt, scale_exp)
 
-    def tip_child(p, code):
+    def tip_child(p, code, B, RK):
         # ump[w,m,c,(r a)] = sum_k tipvec[c,k] P[w,m,r,a,k]; contracted
         # against exact one-hot code vectors (MIC umpX generalization).
         W = code.shape[0]
@@ -296,7 +726,7 @@ def run_chunks(models: kernels.DeviceModels, block_part: jax.Array,
                                    (((3,), (2,)), ((0, 1), (0, 1))),
                                    precision=precision)
 
-    def inner_child(p, idx, clv):
+    def inner_child(p, idx, clv, B, lane, RK):
         # block-diagonal (r,k)->(r,a) contraction: exact same arithmetic
         # as per-rate P application, one MXU-friendly [RK,RK] dot.
         W = idx.shape[0]
@@ -307,30 +737,110 @@ def run_chunks(models: kernels.DeviceModels, block_part: jax.Array,
                                    (((3,), (2,)), ((0, 1), (0, 1))),
                                    precision=precision)
 
-    minlik, two_e, _ = kernels.scale_constants(cdt, scale_exp)
-    for ch in chunks:
+    def apply(clv, scaler, ch: FastChunk):
+        rows, B, lane, R_, K = clv.shape
+        RK = R_ * K
         pl = kernels.p_matrices_wave(models, ch.zl)         # [W,M,R,K,K]
         pr = kernels.p_matrices_wave(models, ch.zr)
         W = ch.width
         if ch.kind == 0:
-            yl = tip_child(pl, ch.lcode)
-            yr = tip_child(pr, ch.rcode)
+            yl = tip_child(pl, ch.lcode, B, RK)
+            yr = tip_child(pr, ch.rcode, B, RK)
             sc = jnp.zeros((W, B, lane), jnp.int32)
         elif ch.kind == 1:
-            yl = tip_child(pl, ch.lcode)
-            yr = inner_child(pr, ch.ridx, clv)
+            yl = tip_child(pl, ch.lcode, B, RK)
+            yr = inner_child(pr, ch.ridx, clv, B, lane, RK)
             sc = scaler[ch.ridx]
         else:
-            yl = inner_child(pl, ch.lidx, clv)
-            yr = inner_child(pr, ch.ridx, clv)
+            yl = inner_child(pl, ch.lidx, clv, B, lane, RK)
+            yr = inner_child(pr, ch.ridx, clv, B, lane, RK)
             sc = scaler[ch.lidx] + scaler[ch.ridx]
         v = yl * yr                                         # [W,B,lane,RK]
         needs = jnp.max(jnp.abs(v), axis=3) < minlik
         v = jnp.where(needs[..., None], v * two_e, v)
         sc = sc + needs.astype(jnp.int32)
-        z0 = jnp.zeros((), ch.base.dtype)
+        z0 = jnp.zeros((), ch.base.dtype if hasattr(ch.base, "dtype")
+                       else jnp.int32)
         clv = jax.lax.dynamic_update_slice(
-            clv, v.reshape(W, B, lane, R, K).astype(clv.dtype),
+            clv, v.reshape(W, B, lane, R_, K).astype(clv.dtype),
             (ch.base, z0, z0, z0, z0))
-        scaler = jax.lax.dynamic_update_slice(scaler, sc, (ch.base, z0, z0))
+        scaler = jax.lax.dynamic_update_slice(scaler, sc,
+                                              (ch.base, z0, z0))
+        return clv, scaler
+
+    return apply
+
+
+def run_chunks(models: kernels.DeviceModels, block_part: jax.Array,
+               tips: kernels.TipState, clv: jax.Array, scaler: jax.Array,
+               chunks, scale_exp: int, precision) -> Tuple[jax.Array, jax.Array]:
+    """Execute an explicit chunk list unrolled, in order (traced; shapes
+    static).  The REFERENCE execution strategy: the segment program
+    (`run_segments`) must match it bit for bit.
+
+    clv is [rows, B, lane, R, K]; writes spill up to width-1 junk rows
+    past each chunk's real entries — the arena reserves slack for the
+    final chunk and intermediate spill is overwritten by later chunks
+    before anything reads it.
+    """
+    apply = chunk_applier(models, block_part, tips, scale_exp, precision)
+    for ch in chunks:
+        clv, scaler = apply(clv, scaler, ch)
+    return clv, scaler
+
+
+def run_segments(profile, base, lidx, ridx, lcode, rcode, zl, zr,
+                 clv, scaler, apply) -> Tuple[jax.Array, jax.Array]:
+    """Execute the bounded program over the PACKED 7-leaf layout:
+    unrolled segments slice their windows statically; scan segments
+    reshape theirs to [glen, step] and run one `lax.scan` whose body
+    executes the step's sub-chunks with the same `apply` kernel, so the
+    program length is O(#segments) while the arithmetic — and execution
+    order — is chunk-for-chunk identical to `run_chunks`."""
+    off = 0
+    coff = 0
+
+    def window(a, o, w):
+        return jax.lax.slice_in_dim(a, o, o + w)
+
+    for seg in profile:
+        if seg[0] == "u":
+            _, k, W = seg
+            ch = FastChunk(k, W, base[coff], window(lidx, off, W),
+                           window(ridx, off, W), window(lcode, off, W),
+                           window(rcode, off, W), window(zl, off, W),
+                           window(zr, off, W))
+            clv, scaler = apply(clv, scaler, ch)
+            off += W
+            coff += 1
+            continue
+        _, glen, subs = seg
+        SW = sum(w for _, w in subs)
+        ns = len(subs)
+        span = glen * SW
+
+        def reshape_xs(a):
+            w = window(a, off, span)
+            return w.reshape((glen, SW) + w.shape[1:])
+
+        xs = (window(base, coff, glen * ns).reshape(glen, ns),
+              reshape_xs(lidx), reshape_xs(ridx), reshape_xs(lcode),
+              reshape_xs(rcode), reshape_xs(zl), reshape_xs(zr))
+
+        def body(carry, x, subs=subs):
+            c, s = carry
+            b, li, ri, lc, rc, zl_, zr_ = x
+            o = 0
+            for j, (k, W) in enumerate(subs):
+                ch = FastChunk(k, W, b[j], window(li, o, W),
+                               window(ri, o, W), window(lc, o, W),
+                               window(rc, o, W), window(zl_, o, W),
+                               window(zr_, o, W))
+                c, s = apply(c, s, ch)
+                o += W
+            return (c, s), None
+
+        (clv, scaler), _ = jax.lax.scan(body, (clv, scaler), xs)
+        off += span
+        coff += glen * ns
     return clv, scaler

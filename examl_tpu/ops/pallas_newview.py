@@ -184,14 +184,13 @@ def _ump(p, table, block_part):
     return um.reshape(W, M, table.shape[0], R * A)[:, block_part]
 
 
-def run_chunks(models, block_part, tips, clv, scaler, chunks,
-               scale_exp: int, precision=None,
-               interpret: bool = False) -> Tuple[jax.Array, jax.Array]:
-    """Drop-in Pallas equivalent of fastpath.run_chunks (f32 only).
-
-    Per-chunk host loop: each chunk is one pallas_call whose donated
-    arena threads through, so the XLA data dependence serializes chunks
-    while everything inside a chunk stays fused in VMEM.
+def chunk_applier(models, block_part, tips, scale_exp: int,
+                  precision=None, interpret: bool = False):
+    """Per-chunk Pallas kernel body (f32 only): the fused-kernel twin of
+    fastpath.chunk_applier, shared by the unrolled chunk loop and the
+    bounded program's lax.scan group bodies (ops/fastpath.run_segments).
+    The [rows,B,lane,R,K]<->[rows,B,lane,RK] reshapes around each call
+    are layout metadata XLA elides.
 
     `precision` applies to the child CLV contractions only (all-positive
     sums; HIGH is within the NUMERICS.md budget); the ump/block-diagonal
@@ -205,21 +204,20 @@ def run_chunks(models, block_part, tips, clv, scaler, chunks,
     # silently measuring a duplicate HIGHEST row; the engine maps its
     # HIGH default to HIGHEST before dispatching here (engine.py
     # `pallas_precision`).
-    rows, B, lane, R, K = clv.shape
-    RK = R * K
     C = tips.table.shape[0]
-    eyeR = jnp.eye(R, dtype=clv.dtype)
-    clvf = clv.reshape(rows, B, lane, RK)
-    zero_rows = jnp.zeros((1, B, lane), jnp.int32)
 
-    for ch in chunks:
+    def apply(clv, scaler, ch):
+        rows, B, lane, R, K = clv.shape
+        RK = R * K
+        eyeR = jnp.eye(R, dtype=clv.dtype)
+        clvf = clv.reshape(rows, B, lane, RK)
         pml = kernels.p_matrices_wave(models, ch.zl)       # [W,M,R,A,K]
         pmr = kernels.p_matrices_wave(models, ch.zr)
         W = ch.width
         if ch.kind == 0:
             opl = _ump(pml, tips.table, block_part)
             opr = _ump(pmr, tips.table, block_part)
-            scsum = jnp.broadcast_to(zero_rows, (W, B, lane))
+            scsum = jnp.zeros((W, B, lane), jnp.int32)
         elif ch.kind == 1:
             opl = _ump(pml, tips.table, block_part)
             opr = _block_diag_p(pmr, block_part, eyeR)
@@ -231,8 +229,28 @@ def run_chunks(models, block_part, tips, clv, scaler, chunks,
         # tip codes as int32 rows [W,B,lane] (uint8 gather done in XLA)
         lcodes = tips.codes[ch.lcode].astype(jnp.int32)
         rcodes = tips.codes[ch.rcode].astype(jnp.int32)
+        base = (ch.base[None] if getattr(ch.base, "ndim", 0) == 0
+                else ch.base)
         clvf, scaler = _run_chunk(
-            clvf, scaler, ch.lidx, ch.ridx, ch.base[None], opl, opr,
+            clvf, scaler, ch.lidx, ch.ridx, base, opl, opr,
             lcodes, rcodes, scsum, kind=ch.kind, W=W, C=C,
             scale_exp=scale_exp, precision=precision, interpret=interpret)
-    return clvf.reshape(rows, B, lane, R, K), scaler
+        return clvf.reshape(rows, B, lane, R, K), scaler
+
+    return apply
+
+
+def run_chunks(models, block_part, tips, clv, scaler, chunks,
+               scale_exp: int, precision=None,
+               interpret: bool = False) -> Tuple[jax.Array, jax.Array]:
+    """Drop-in Pallas equivalent of fastpath.run_chunks (f32 only).
+
+    Per-chunk host loop: each chunk is one pallas_call whose donated
+    arena threads through, so the XLA data dependence serializes chunks
+    while everything inside a chunk stays fused in VMEM.
+    """
+    apply = chunk_applier(models, block_part, tips, scale_exp,
+                          precision=precision, interpret=interpret)
+    for ch in chunks:
+        clv, scaler = apply(clv, scaler, ch)
+    return clv, scaler

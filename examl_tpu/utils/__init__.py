@@ -17,6 +17,18 @@ def next_pow2(n: int) -> int:
     return p
 
 
+def bucket_len(n: int) -> int:
+    """Round a count up to a bucketed size: multiples of 4 up to 16,
+    then geometric buckets with <=25% padding (n rounded up to a
+    multiple of 2^(floor(log2 n) - 2)).  Shared by the engine's
+    traversal-length bucketing and the fast path's scan-group lengths:
+    O(log n) distinct compiled variants, bounded padding waste."""
+    if n <= 16:
+        return 4 * ((n + 3) // 4)
+    step = next_pow2(n + 1) // 8
+    return step * ((n + step - 1) // step)
+
+
 def z_slots(z: "Sequence[float] | float", num_slots: int) -> np.ndarray:
     """Normalize a branch-length vector to [num_slots] float64.
 

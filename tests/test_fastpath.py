@@ -95,3 +95,260 @@ def test_fast_path_binary_and_small():
     lnl = inst.evaluate(tree, full=True)
     ref = oracle_lnl(tree, ad, inst.models)
     assert lnl == pytest.approx(ref, rel=1e-10)
+
+
+# -- bounded-program equivalence matrix (ISSUE 5) ----------------------------
+# Width bucketing + chunk coalescing + the scanned long tail must be
+# invisible to the numbers: the bounded layout's lnL matches the legacy
+# one-block-per-chunk unroll and the scan tier bit-for-bit on these
+# fixtures, the lax.scan groups match their own unrolled execution
+# bit-for-bit BY CONSTRUCTION (same kernel body, same order), and any
+# valid re-split of the waves preserves per-node arena contents.
+
+import os
+
+import jax.numpy as jnp
+
+from examl_tpu import obs
+from examl_tpu.ops import fastpath
+from examl_tpu.tree.topology import Tree, hookup
+
+
+def _synth(n=40, width=97, seed=0):
+    rng = np.random.default_rng(seed)
+    names = [f"t{i}" for i in range(n)]
+    seqs = ["".join("ACGT"[b] for b in rng.integers(0, 4, width))
+            for _ in range(n)]
+    return build_alignment_data(names, seqs)
+
+
+@pytest.fixture(scope="module")
+def sdata():
+    return _synth()
+
+
+def _counter(name):
+    return obs.counter(name)
+
+
+def _eval(data, seed=3, force_scan=False, bounded=True, **kw):
+    if not bounded:
+        os.environ["EXAML_BOUNDED_CHUNKS"] = "0"
+    try:
+        inst = PhyloInstance(data, **kw)
+        tree = inst.random_tree(seed)
+        if force_scan:
+            for e in inst.engines.values():
+                e.force_scan = True
+        return inst, tree, inst.evaluate(tree, full=True)
+    finally:
+        os.environ.pop("EXAML_BOUNDED_CHUNKS", None)
+
+
+def test_bounded_matches_legacy_and_scan_bitwise(sdata):
+    """The tentpole acceptance: bounded layout vs the uncapped unroll vs
+    the scan tier, bit-identical lnL on the f64 fixture (all three tip
+    cases present in a 40-taxon random tree)."""
+    _, _, lnl_b = _eval(sdata)
+    _, _, lnl_l = _eval(sdata, bounded=False)
+    _, _, lnl_s = _eval(sdata, force_scan=True)
+    assert lnl_b == lnl_l
+    assert lnl_b == lnl_s
+
+
+def test_bounded_matches_legacy_per_partition_branches(sdata):
+    """C>1 branch slots through the packed z plumbing."""
+    _, _, lnl_b = _eval(sdata, per_partition_branches=True)
+    _, _, lnl_l = _eval(sdata, bounded=False,
+                        per_partition_branches=True)
+    assert lnl_b == lnl_l
+
+
+def test_bounded_matches_sev_scan(sdata):
+    """-S (SEV pools) has no fast path; the bounded chunk tier must
+    agree with the pooled scan evaluation on the same tree."""
+    _, _, lnl_b = _eval(sdata)
+    _, _, lnl_s = _eval(sdata, save_memory=True)
+    assert lnl_s == pytest.approx(lnl_b, rel=1e-12, abs=1e-7)
+
+
+def test_profile_bounded_and_builders_agree(sdata):
+    """Both builders produce the identical bucketed layout (equivalence
+    contract); the profile is made of ladder widths only and its
+    operation count is far below the raw chunk count."""
+    inst = PhyloInstance(sdata)
+    tree = inst.random_tree(3)
+    p = tree.centroid_branch()
+    if tree.is_tip(p.number):
+        p = p.back
+    flat = tree.flat_full_traversal(p)
+    n = inst.alignment.ntaxa
+    st = fastpath.build_structure(flat, n)
+    sch = fastpath.build_schedule(flat.to_entries(), n, 1, jnp.float64)
+    assert st.profile == sch.profile
+    assert st.max_write == sch.max_write
+    assert st.num_rows == sch.num_rows
+    un, sc, total = fastpath.profile_stats(st.profile)
+    assert sc >= 1, st.profile            # the long tail actually scans
+    assert un + sc < total                # fewer ops than chunks
+    kinds = {0, 1, 2}
+    for k, w in fastpath.iter_profile_chunks(st.profile):
+        assert k in kinds
+        assert w >= fastpath.MIN_WIDTH and w <= fastpath.CHUNK_CAP
+        assert w & (w - 1) == 0           # ladder = powers of two
+
+
+def test_segment_program_matches_unrolled_bitwise(sdata):
+    """The lax.scan groups execute the identical chunk kernel in the
+    identical order: real arena rows and scalers bit-equal to the
+    unrolled execution of the same chunk list."""
+    inst = PhyloInstance(sdata)
+    tree = inst.random_tree(3)
+    (eng,) = inst.engines.values()
+    p = tree.centroid_branch()
+    if tree.is_tip(p.number):
+        p = p.back
+    flat = tree.flat_full_traversal(p)
+    n = inst.alignment.ntaxa
+    sch = fastpath.build_schedule(flat.to_entries(), n, 1, eng.dtype)
+    apply = fastpath.chunk_applier(eng.models, eng.block_part, eng.tips,
+                                   eng.scale_exp, eng.fast_precision)
+    c1, s1 = fastpath.run_chunks(
+        eng.models, eng.block_part, eng.tips, jnp.array(eng.clv),
+        jnp.array(eng.scaler), sch.chunks, eng.scale_exp,
+        eng.fast_precision)
+    c2, s2 = fastpath.run_segments(
+        sch.profile, sch.base, sch.lidx, sch.ridx, sch.lcode, sch.rcode,
+        sch.zl, sch.zr, jnp.array(eng.clv), jnp.array(eng.scaler), apply)
+    rows = np.asarray(sorted(sch.row_of.values()))
+    assert (np.asarray(c1)[rows] == np.asarray(c2)[rows]).all()
+    assert (np.asarray(s1)[rows] == np.asarray(s2)[rows]).all()
+
+
+def test_wave_resplit_preserves_arena_rows(sdata):
+    """Property: entries within a wave are independent, so any valid
+    re-split/reorder of the waves (here: random within-wave entry
+    permutations, which reshuffle chunk membership and row assignment)
+    preserves every node's arena row contents bit-for-bit."""
+    inst = PhyloInstance(sdata)
+    tree = inst.random_tree(3)
+    (eng,) = inst.engines.values()
+    n = inst.alignment.ntaxa
+    _, entries = tree.full_traversal_centroid()
+
+    def run(ents):
+        sch = fastpath.build_schedule(ents, n, 1, eng.dtype)
+        c, s = fastpath.run_chunks(
+            eng.models, eng.block_part, eng.tips, jnp.array(eng.clv),
+            jnp.array(eng.scaler), sch.chunks, eng.scale_exp,
+            eng.fast_precision)
+        c, s = np.asarray(c), np.asarray(s)
+        return {num: (c[r], s[r]) for num, r in sch.row_of.items()}
+
+    base = run(entries)
+    rng = np.random.default_rng(11)
+    for trial in range(3):
+        waves = Tree.schedule_waves(entries)
+        shuffled = []
+        for w in waves:
+            w = list(w)
+            rng.shuffle(w)
+            shuffled.extend(w)
+        got = run(shuffled)
+        assert got.keys() == base.keys()
+        for num in base:
+            assert (got[num][0] == base[num][0]).all(), (trial, num)
+            assert (got[num][1] == base[num][1]).all(), (trial, num)
+
+
+def test_bounded_after_spr_commit_seam(sdata):
+    """The cache-invalidation seam: a real SPR rearrange + commit, then
+    a full evaluate — bounded layout vs scan tier on the same moved
+    tree, bit-identical."""
+    from examl_tpu.constants import UNLIKELY
+    from examl_tpu.search.spr import (SprContext, rearrange,
+                                      restore_tree_fast)
+
+    def run(force_scan):
+        inst = PhyloInstance(sdata)
+        tree = inst.random_tree(9)
+        if force_scan:
+            for eng in inst.engines.values():
+                eng.force_scan = True
+        inst.evaluate(tree, full=True)
+        ctx = SprContext(inst)
+        ctx.start_lh = ctx.end_lh = inst.likelihood
+        ctx.best_of_node = UNLIKELY
+        p = next(s for s in (tree.nodep[i]
+                             for i in tree.inner_numbers())
+                 if not tree.is_tip(s.back.number))
+        assert rearrange(inst, tree, ctx, p, 1, 3)
+        if ctx.end_lh > ctx.start_lh:
+            restore_tree_fast(inst, tree, ctx)
+        lnl = inst.evaluate(tree, full=True)
+        return float(lnl), tree.to_newick(inst.alignment.taxon_names)
+
+    lnl_f, nwk_f = run(False)
+    lnl_s, nwk_s = run(True)
+    assert nwk_f == nwk_s
+    assert lnl_f == lnl_s
+
+
+def test_cross_topology_profile_shares_program(sdata):
+    """The point of width bucketing: two DIFFERENT topologies (distinct
+    topo_key, so the structure cache misses twice) with the same
+    bucketed profile dispatch through ONE compiled program — the second
+    evaluate is a jit-cache hit and compiles nothing new."""
+    inst = PhyloInstance(sdata)
+    tree_a = inst.random_tree(3)
+    names = inst.alignment.taxon_names
+    text = tree_a.to_newick(names)
+    # Same shape, different tip placement: rotate the taxon labels one
+    # position, so node numbers (and the topology signature) change
+    # while every wave/kind/width — and therefore the profile — stays.
+    rot = {names[i]: names[(i + 1) % len(names)] for i in range(len(names))}
+    import re
+    text_b = re.sub("|".join(sorted(rot, key=len, reverse=True)),
+                    lambda m: rot[m.group(0)], text)
+    tree_b = inst.tree_from_newick(text_b)
+
+    (eng,) = inst.engines.values()
+    m0 = _counter("engine.sched_cache.miss")
+    c0 = _counter("engine.compile_count")
+    lnl_a = inst.evaluate(tree_a, full=True)
+    keys_after_a = len(eng._fast_jit_cache)
+    misses_a = _counter("engine.sched_cache.miss")
+    compiles_a = _counter("engine.compile_count")
+    assert misses_a >= m0 + 1
+    h0 = _counter("engine.cache_hits")
+    lnl_b = inst.evaluate(tree_b, full=True)
+    assert np.isfinite(lnl_b) and lnl_b != pytest.approx(lnl_a, abs=1e-6)
+    # Different topology: new structure (cache miss) ...
+    assert _counter("engine.sched_cache.miss") >= misses_a + 1
+    # ... same bucketed profile: the jitted program is REUSED.
+    st_a = next(iter(eng._sched_cache.values()))
+    assert _counter("engine.cache_hits") >= h0 + 1
+    assert len(eng._fast_jit_cache) == keys_after_a
+    assert _counter("engine.compile_count") == compiles_a
+    # The jit key is the bucketed profile (small-fix satellite): the
+    # shared entry is keyed by the segment tuple both schedules mint.
+    assert ("fast", st_a.profile, "flat", True) in eng._fast_jit_cache
+
+
+def test_program_gauges_published(sdata):
+    """obs satellite: program_chunks / scan_groups /
+    dispatches_per_traversal gauges land in metrics snapshots, tagged
+    per engine so multiple engines never overwrite each other."""
+    inst = PhyloInstance(sdata)
+    tree = inst.random_tree(3)
+    inst.evaluate(tree, full=True)
+    (eng,) = inst.engines.values()
+    tag = "." + eng._obs_tag
+    g = obs.snapshot()["gauges"]
+    assert g.get("engine.program_chunks" + tag, 0) >= 1
+    assert "engine.scan_groups" + tag in g
+    assert g.get("engine.dispatches_per_traversal" + tag, 0) >= 1
+    assert (g["engine.program_chunks" + tag]
+            + g["engine.scan_groups" + tag]
+            == g["engine.dispatches_per_traversal" + tag])
+    assert g["engine.program_chunks" + tag] <= 256

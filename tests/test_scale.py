@@ -134,6 +134,28 @@ def test_native_newick_scanner_parity():
 
 
 @pytest.mark.slow
+def test_chunk_tier_50k_bounded_compile():
+    """ISSUE 5 acceptance: the bounded chunk program at 50k synthetic
+    taxa stays under the 256-unrolled-block cap, compiles on CPU inside
+    the scale-lab budget (measured ~37 s vs tens of minutes unrolled),
+    and its lnL matches the scan tier (tools/scale_lab.py asserts the
+    same at the 5k smoke size in CI)."""
+    import os
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "tools"))
+    import scale_lab
+
+    res = scale_lab.run_size(50_000, 64)
+    assert 1 <= res["program_chunks"] <= 256, res["program_chunks"]
+    assert res["dispatches_per_traversal"] < res["chunks"] / 5
+    assert res["lnl_fast"] is not None
+    assert abs(res["lnl"] - res["lnl_fast"]) <= max(
+        1e-6 * abs(res["lnl"]), 1e-3), (res["lnl"], res["lnl_fast"])
+
+
+@pytest.mark.slow
 def test_host_paths_50k_taxa_within_budget():
     """The host-side pipeline at 50k taxa (reference ambition ~120k,
     SURVEY §6) stays interactive: random-addition build is O(n) via the
