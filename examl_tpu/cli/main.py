@@ -79,6 +79,30 @@ def build_argparser() -> argparse.ArgumentParser:
                          "(kill + scan-tier fallback) for --bank "
                          "workers, watchdog-bark threshold for any "
                          "in-process compile (default 180)")
+    ap.add_argument("--launch", dest="launch", type=int, default=None,
+                    metavar="N",
+                    help="gang mode: the supervisor spawns all N ranks "
+                         "itself (per-rank EXAML_PROCID, killable "
+                         "process groups, local coordinator), watches "
+                         "the per-rank heartbeats, and on any rank "
+                         "death / single-rank straggler / collective "
+                         "wedge kills and restarts the WHOLE gang from "
+                         "the newest coordinated checkpoint "
+                         "(--supervise-* flags apply gang-wide); a rank "
+                         "that keeps dying shrinks the gang to N-1 "
+                         "(elastic resume, down to --launch-min-ranks)")
+    ap.add_argument("--launch-emulate", dest="launch_emulate",
+                    action="store_true",
+                    help="spawn the --launch gang WITHOUT a jax "
+                         "distributed process group (N independent "
+                         "single-process ranks honoring the same "
+                         "rank/heartbeat/checkpoint contract) — for "
+                         "backends without multi-process collectives "
+                         "and for chaos tests")
+    ap.add_argument("--launch-min-ranks", dest="launch_min_ranks",
+                    type=int, default=1,
+                    help="elastic-resume floor: never shrink the gang "
+                         "below this many ranks (default 1)")
     ap.add_argument("--supervise", dest="supervise", action="store_true",
                     help="self-healing supervision: run the search as a "
                          "killable child, watch its search-loop "
@@ -106,10 +130,11 @@ def build_argparser() -> argparse.ArgumentParser:
                     action="append", metavar="SPEC", default=None,
                     help="arm a named fault-injection point (repeatable; "
                          "resilience/faults.py): "
-                         "point[:after=N][:attempt=K][:signal=NAME]"
-                         "[:hang[=S]] — e.g. search.kill:after=10 or "
-                         "heartbeat.stall:after=5; equivalent to "
-                         "EXAML_FAULTS entries")
+                         "point[@rank=R][:after=N][:attempt=K]"
+                         "[:signal=NAME][:hang[=S]] — e.g. "
+                         "search.kill:after=10 or "
+                         "search.kill@rank=1:after=10 (gang rank 1 "
+                         "only); equivalent to EXAML_FAULTS entries")
     ap.add_argument("--profile", dest="profile_dir", default=None,
                     help="write a jax profiler trace to this directory "
                          "(SURVEY §5.1; view with xprof/tensorboard)")
@@ -313,13 +338,27 @@ def _read_trees(path: str):
     return [t.strip() + ";" for t in text.split(";") if t.strip()]
 
 
-def run_search(args, inst, files: RunFiles) -> int:
+def _checkpoint_manager(args, **kwargs):
+    """The run's CheckpointManager: gang ranks (`--launch N`) share the
+    two-phase manager over the ORIGINAL workdir — rank>0's output files
+    are diverted to per-rank scratch, but checkpoint cycles must stage
+    and publish in ONE directory or the commit protocol has nothing to
+    coordinate."""
     from examl_tpu.search.checkpoint import CheckpointManager
+    gang = getattr(args, "_gang", None)
+    if gang is not None:
+        rank, size, shared = gang
+        return CheckpointManager(shared, args.run_id, gang_rank=rank,
+                                 gang_size=size, **kwargs)
+    return CheckpointManager(args.workdir, args.run_id, **kwargs)
+
+
+def run_search(args, inst, files: RunFiles) -> int:
     from examl_tpu.search.convergence import RfConvergence
     from examl_tpu.search.raxml_search import (SearchOptions,
                                                compute_big_rapid)
 
-    mgr = CheckpointManager(args.workdir, args.run_id)
+    mgr = _checkpoint_manager(args)
     resume = None
     constraint = None
     if args.restart:
@@ -442,7 +481,6 @@ def run_tree_evaluation(args, inst, files: RunFiles) -> int:
     and the -f e checkpoint leg `axml.c:2276-2296`)."""
     from examl_tpu.optimize.branch import tree_evaluate
     from examl_tpu.optimize.model_opt import mod_opt
-    from examl_tpu.search.checkpoint import CheckpointManager
 
     if not args.tree_file:
         files.info("tree evaluation mode requires -t")
@@ -456,8 +494,20 @@ def run_tree_evaluation(args, inst, files: RunFiles) -> int:
     # -f e over thousands of trees: keep only the last 2 numbered
     # checkpoints (each embeds the accumulated results) and rate-limit
     # the mid-optimization cadence, else checkpoint bytes grow O(N^2).
-    mgr = CheckpointManager(args.workdir, args.run_id, keep_last=2)
+    mgr = _checkpoint_manager(args, keep_last=2)
     last_ckpt = [0.0]
+    # Gang runs (--launch) must skip the wall-clock mid-tree cadence
+    # below: two-phase cycle numbers are each rank's write COUNT, and a
+    # per-rank wall-clock gate would let ranks' counts drift apart —
+    # once the drift exceeds keep_last the staged halves of a cycle
+    # never meet and publishing stalls until the next restart resyncs
+    # counters from the published set.  Gang ranks checkpoint per
+    # FINISHED tree (a deterministic, rank-aligned cadence); a pending
+    # preemption still stages immediately, which is safe even when
+    # ranks sit on different trees — an incomplete cycle never
+    # publishes, restore GCs it, and at most the in-flight tree is
+    # redone.
+    gang = getattr(args, "_gang", None) is not None
 
     start_i = 0
     results = []
@@ -492,6 +542,8 @@ def run_tree_evaluation(args, inst, files: RunFiles) -> int:
 
         def ckpt_cb(state: str, extras: dict, i=i, tree=tree) -> None:
             from examl_tpu.resilience import preempt
+            if gang and not preempt.requested():
+                return                      # gang cadence: per finished tree
             if (time.time() - last_ckpt[0] < 60.0
                     and not preempt.requested()):
                 return                      # mid-tree cadence: >= 60 s apart
@@ -568,6 +620,23 @@ def main(argv=None) -> int:
         except ValueError as exc:
             ap.error(f"--inject-fault: {exc}")
 
+    if args.launch is not None:
+        if args.launch < 1:
+            ap.error("--launch requires at least 1 rank")
+        if args.procid is not None or args.coordinator is not None \
+                or args.nprocs is not None:
+            ap.error("--launch spawns every rank itself (it supplies "
+                     "--coordinator/--nprocs/--procid per rank); it "
+                     "cannot be combined with --nprocs/--procid/"
+                     "--coordinator — for a manually-launched multi-host "
+                     "job drop --launch")
+        # Gang mode: this process becomes the jax-free gang supervisor
+        # (resilience/supervisor.GangSupervisor); every rank is a
+        # killable child with EXAML_PROCID/EXAML_GANG_RANKS exported.
+        # --supervise is implied (the gang IS the supervision unit).
+        from examl_tpu.resilience import supervisor as _supervisor
+        return _supervisor.launch_gang(raw_argv, args, log=print)
+
     if args.supervise:
         # Self-healing supervision: this process becomes a thin, jax-free
         # watcher (resilience/supervisor.py) and the ENTIRE run — faults,
@@ -605,12 +674,33 @@ def main(argv=None) -> int:
     # per-process scratch dir so nothing clobbers.
     init_distributed(args, log=print)
     primary = True
+    gang_rank = 0
+    gang_dir = args.workdir            # shared dir, BEFORE any diversion
     if args.nprocs is not None or args.coordinator is not None:
         import jax
-        primary = jax.process_index() == 0
-        if not primary:
-            args.workdir = os.path.join(args.workdir,
-                                        f".proc{jax.process_index()}")
+        gang_rank = jax.process_index()
+        primary = gang_rank == 0
+        # Canonicalize the rank into EXAML_PROCID for manually-launched
+        # multi-host jobs too (the gang supervisor already exports it):
+        # rank-targeted fault specs (`point@rank=R`) and the trace
+        # procid resolver key off this env var.
+        os.environ.setdefault(_heartbeat.PROCID_VAR, str(gang_rank))
+    elif _heartbeat.env_gang_size():
+        # Emulated gang rank (--launch N --launch-emulate): no process
+        # group exists, but the rank contract — process-0 output
+        # gating, per-rank scratch dirs, per-rank heartbeats,
+        # coordinated checkpoints in the SHARED dir — is identical.
+        gang_rank = _heartbeat.env_rank()
+        primary = gang_rank == 0
+    if not primary:
+        args.workdir = os.path.join(args.workdir, f".proc{gang_rank}")
+    # Coordinated (two-phase) checkpointing applies exactly when the
+    # gang supervisor spawned us: it guarantees one shared filesystem
+    # and exports the world size.  Manually-launched multi-host jobs
+    # keep the classic per-process checkpoint behavior.
+    gang_size = _heartbeat.env_gang_size()
+    args._gang = ((gang_rank, gang_size, gang_dir)
+                  if gang_size and gang_size > 1 else None)
     files = RunFiles(args.workdir, args.run_id, append=args.restart,
                      primary=primary)
     # Observability wiring: per-process trace files named by procid

@@ -8,10 +8,13 @@ from __future__ import annotations
 
 
 def run_quartets(args, inst, files) -> int:
-    from examl_tpu.search.checkpoint import CheckpointManager
+    from examl_tpu.cli.main import _checkpoint_manager
     from examl_tpu.search.quartets import QuartetOptions, compute_quartets
 
-    mgr = CheckpointManager(args.workdir, args.run_id)
+    # Gang-aware (--launch): quartet checkpoint cycles fire at the
+    # deterministic per-interval sites, so ranks' cycle counts stay
+    # aligned and the two-phase commit applies unchanged.
+    mgr = _checkpoint_manager(args)
     resume = None
     if args.restart:
         tree = inst.random_tree(seed=args.seed)     # overwritten by restore

@@ -88,10 +88,15 @@ FALLBACK_ENV = {
 MANIFEST_NAME = "bank_manifest.json"
 
 # Process-wide bank state: which families this run banked (consulted by
-# engine._guard_first_call to attribute first-call compiles), and
-# whether we are inside the bank phase right now (main-process warm).
+# engine._guard_first_call to attribute first-call compiles), whether we
+# are inside the bank phase right now (main-process warm), and whether
+# this is a multi-process run whose MESH-SHARDED program variants cannot
+# bank in workers (ROADMAP §4: workers cannot join the parent's
+# distributed process group, so those first compiles run in-process —
+# watchdogged, not killable).
 _STATE = {"active": False, "banked": set(), "degraded": {},
-          "in_phase": False, "pinned": {}}
+          "in_phase": False, "pinned": {}, "sharded_residual": False,
+          "enumerated": set()}
 
 
 def reset() -> None:
@@ -106,7 +111,8 @@ def reset() -> None:
         else:
             os.environ[var] = prior
     _STATE.update(active=False, banked=set(), degraded={},
-                  in_phase=False, pinned={})
+                  in_phase=False, pinned={}, sharded_residual=False,
+                  enumerated=set())
 
 
 def active() -> bool:
@@ -123,6 +129,29 @@ def is_banked(family: str) -> bool:
 
 def degraded() -> Dict[str, str]:
     return dict(_STATE["degraded"])
+
+
+def sharded_residual(family: Optional[str] = None) -> bool:
+    """True when this banked run is multi-process, i.e. its mesh-sharded
+    program variants could NOT bank in workers and legitimately
+    first-compile in the main process (watchdogged).  The engine's
+    first-call monitor uses this to count
+    `engine.first_calls.inprocess_sharded` instead of the
+    enumeration-gap acceptance counter `unbanked` — but ONLY for
+    families the bank actually ENUMERATED (pass `family`): a family the
+    enumeration missed entirely is a genuine gap and must still trip
+    `unbanked`, multi-process or not."""
+    if not _STATE["sharded_residual"]:
+        return False
+    return family is None or family in _STATE["enumerated"]
+
+
+def _world_size() -> int:
+    try:
+        import jax
+        return jax.process_count()
+    except Exception:                 # noqa: BLE001
+        return 1
 
 
 # ---------------------------------------------------------------------------
@@ -738,6 +767,23 @@ def run_bank(args, log=lambda msg: None, timeout: Optional[float] = None,
     _STATE["active"] = True
     _STATE["banked"] = {f for f, r in report.items()
                         if r.get("status") == "banked"}
+    _STATE["enumerated"] = set(families)
+    world = _world_size()
+    if world > 1:
+        # ROADMAP §4 observability: workers cannot join this job's
+        # distributed process group, so every family's MESH-SHARDED
+        # variant still first-compiles in the main process (watchdogged,
+        # not killable).  Make the residual exposure explicit — in the
+        # manifest AND in `engine.first_calls.inprocess_sharded` —
+        # instead of letting chip-round artifacts hide it in `unbanked`.
+        _STATE["sharded_residual"] = True
+        for r in report.values():
+            r["mesh_sharded_inprocess"] = True
+        obs.inc("bank.sharded_residual_families", len(report))
+        log(f"bank: {world}-process job — mesh-sharded program variants "
+            "cannot bank in workers (no process group); their first "
+            "compiles run in-process, watchdogged "
+            "(engine.first_calls.inprocess_sharded)")
     _save_manifest(cache_path, report, log)
     return report
 

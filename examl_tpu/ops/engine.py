@@ -809,6 +809,18 @@ class LikelihoodEngine:
                         obs.inc("engine.first_calls.degraded_inprocess")
                         obs.inc("engine.first_calls."
                                 f"degraded_inprocess.{family}")
+                    elif bank.sharded_residual(family):
+                        # Multi-process run AND the bank enumerated
+                        # this family: its mesh-sharded variant can
+                        # only first-compile here (workers cannot join
+                        # the process group — ROADMAP §4).  This is the
+                        # bank's DOCUMENTED residual wedge exposure,
+                        # not an enumeration gap; a family the
+                        # enumeration MISSED falls through to
+                        # `unbanked`, the pure acceptance counter.
+                        obs.inc("engine.first_calls.inprocess_sharded")
+                        obs.inc("engine.first_calls."
+                                f"inprocess_sharded.{family}")
                     else:
                         obs.inc("engine.first_calls.unbanked")
                         obs.inc(f"engine.first_calls.unbanked.{family}")

@@ -101,7 +101,10 @@ def install_heartbeat(args, log=lambda msg: None) -> Optional[str]:
     keeps the configured path — its supervisor watches exactly that
     file; processes >0 of a multi-host job append `.p<procid>` so the
     job's beats never clobber one file (one shared file would mask a
-    single wedged peer behind its neighbors' beats).  Call AFTER
+    single wedged peer behind its neighbors' beats).  EMULATED gang
+    ranks (`--launch N --launch-emulate`: EXAML_GANG_RANKS/EXAML_PROCID
+    set with no distributed flags) follow the identical naming — the
+    gang watcher aggregates the same files either way.  Call AFTER
     init_distributed so the procid is the job's, not a guess."""
     from examl_tpu.resilience import heartbeat
 
@@ -112,8 +115,9 @@ def install_heartbeat(args, log=lambda msg: None) -> Optional[str]:
     if getattr(args, "nprocs", None) is not None or \
             getattr(args, "coordinator", None) is not None:
         import jax
-        if jax.process_index() != 0:
-            path = f"{base}.p{jax.process_index()}"
+        path = heartbeat.rank_path(base, jax.process_index())
+    elif heartbeat.env_gang_size():
+        path = heartbeat.rank_path(base, heartbeat.env_rank())
     path = heartbeat.install(path)
     log(f"heartbeat -> {path}")
     return path

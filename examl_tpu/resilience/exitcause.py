@@ -36,6 +36,17 @@ EXIT_USAGE = 2
 CAUSE_OK = "ok"
 CAUSE_PREEMPT = "preempt"          # clean SIGTERM/SIGINT checkpoint+exit
 CAUSE_HANG_KILL = "hang-kill"      # the watcher killed it (stall/deadline)
+# Gang-watcher verdicts (never produced by classify(rc) — like
+# hang-kill they are the WATCHER's judgement, which outranks the raw
+# signal of the SIGKILL it sent):
+CAUSE_COLLECTIVE_WEDGE = "collective-wedge"  # ALL ranks' beats went
+                                   # stale together: a wedged collective
+                                   # (psum/allreduce) or program hang
+                                   # every rank is blocked inside
+CAUSE_STRAGGLER = "straggler-stall"  # ONE rank stopped beating while
+                                   # its peers stayed fresh: a rank-local
+                                   # stall (lockstep means the fresh
+                                   # peers are already blocked on it)
 CAUSE_OOM_KILL = "oom-kill"        # external SIGKILL: the kernel OOM
                                    # killer is the usual sender when the
                                    # watcher did not kill it itself
@@ -49,14 +60,18 @@ CAUSE_RUNNING = "running"
 # Causes a supervisor may retry.  "usage" and "ok" are final; "preempt"
 # is resumable but handled on a separate (non-retry-budget) path.
 RETRYABLE = frozenset({CAUSE_HANG_KILL, CAUSE_OOM_KILL, CAUSE_SIGILL,
-                       CAUSE_CRASH, CAUSE_TERMINATED, CAUSE_ERROR})
+                       CAUSE_CRASH, CAUSE_TERMINATED, CAUSE_ERROR,
+                       CAUSE_COLLECTIVE_WEDGE, CAUSE_STRAGGLER})
 
 # Causes that indicate the *program tier* (not the environment) may be
 # at fault — these escalate the supervisor's degradation ladder
 # (pallas→chunk→scan), mirroring the bank's `_is_wedge` rule that only
 # deadline kills and deaths-by-signal justify routing around a family.
+# A collective wedge is the program-wedge class by definition; a
+# single-rank straggler is presumed environmental (one slow/blocked
+# host) and retries on the same tier.
 TIER_SUSPECT = frozenset({CAUSE_HANG_KILL, CAUSE_SIGILL, CAUSE_CRASH,
-                          CAUSE_OOM_KILL})
+                          CAUSE_OOM_KILL, CAUSE_COLLECTIVE_WEDGE})
 
 def exit_desc(rc: Optional[int], none_desc: str = "(still running)") -> str:
     """Human-readable exit cause for a Popen returncode.

@@ -9,8 +9,13 @@ failure mode does.
 
 Arming (comma-separated specs, via `EXAML_FAULTS` or `--inject-fault`):
 
-    point[:after=N][:attempt=K][:signal=NAME][:hang[=SECS]][:raise]
+    point[@rank=R][:after=N][:attempt=K][:signal=NAME][:hang[=SECS]][:raise]
 
+* `@rank=R`   — RANK-TARGETED injection: fire only in the process whose
+  gang rank (`EXAML_PROCID`, set per rank by the `--launch` gang
+  supervisor and real multi-host launches) equals R.  Non-target ranks
+  never tick the point's hit counter, so `after=N` keeps addressing
+  "the Nth iteration of rank R".  Also accepted as a `:rank=R` field.
 * `after=N`   — fire on the Nth check of the point (default 1).
 * `attempt=K` — fire only when `EXAML_RESTART_COUNT` == K (default 0,
   i.e. only the supervisor's FIRST attempt; `attempt=*` fires on every
@@ -50,6 +55,8 @@ POINTS = {
     "engine.nonfinite": "poison the dispatched log-likelihood with NaN",
     "compile.hang": "hang inside the first-call compile monitor",
     "checkpoint.write": "fail a checkpoint write before publish",
+    "checkpoint.publish": "fail/kill between a fully-staged gang "
+                          "checkpoint cycle and its publish rename",
     "bank.worker": "kill/hang a bank compile worker at family start",
     "search.kill": "signal self at the Nth search-loop heartbeat",
     "heartbeat.stall": "stop emitting heartbeats (sticky)",
@@ -77,6 +84,7 @@ class FaultSpec:
     attempt: Optional[int] = 0          # None = every attempt ("*")
     action: str = "raise"               # raise | signal | hang | flag
     arg: object = None                  # signal name / hang seconds
+    rank: Optional[int] = None          # None = every rank
 
 
 def parse_spec(text: str) -> Dict[str, FaultSpec]:
@@ -91,13 +99,20 @@ def parse_spec(text: str) -> Dict[str, FaultSpec]:
         if not item:
             continue
         fields = item.split(":")
-        point = fields[0]
+        point, _, ranktag = fields[0].partition("@")
         if point not in POINTS:
             raise ValueError(
                 f"unknown fault point {point!r} (known: "
                 + ", ".join(sorted(POINTS)) + ")")
         action, arg = _DEFAULT_ACTION.get(point, ("raise", None))
         spec = FaultSpec(point=point, action=action, arg=arg)
+        if ranktag:
+            key, _, val = ranktag.partition("=")
+            if key != "rank" or not val:
+                raise ValueError(
+                    f"bad rank qualifier {ranktag!r} in {item!r} "
+                    "(expected point@rank=R)")
+            spec.rank = int(val)
         for f in fields[1:]:
             key, _, val = f.partition("=")
             if key == "after":
@@ -111,8 +126,18 @@ def parse_spec(text: str) -> Dict[str, FaultSpec]:
                 spec.arg = float(val) if val else 3600.0
             elif key == "raise":
                 spec.action, spec.arg = "raise", None
+            elif key == "rank":
+                spec.rank = int(val)
             else:
                 raise ValueError(f"unknown fault field {f!r} in {item!r}")
+        if point in specs:
+            # One spec per point: silently keeping only the last would
+            # make e.g. "search.kill@rank=0,search.kill@rank=1" arm a
+            # DIFFERENT chaos scenario than written.  (To hit every
+            # rank, omit the rank qualifier.)
+            raise ValueError(
+                f"duplicate spec for fault point {point!r}: only one "
+                "spec per point may be armed")
         specs[point] = spec
     return specs
 
@@ -159,11 +184,24 @@ def _attempt() -> int:
         return 0
 
 
+def _rank() -> int:
+    """This process's gang rank — one parser for EXAML_PROCID:
+    resilience/heartbeat.py owns it (lazy import; heartbeat imports
+    this module at load time)."""
+    from examl_tpu.resilience import heartbeat
+    return heartbeat.env_rank()
+
+
 def armed(point: str) -> Optional[FaultSpec]:
     """Check (and count) one hit of `point`; the spec when THIS hit
     fires, else None.  Sticky points keep firing once triggered."""
     spec = _specs().get(point)
     if spec is None:
+        return None
+    if spec.rank is not None and _rank() != spec.rank:
+        # Rank-targeted spec in a non-target rank: inert, and it must
+        # not tick the hit counter — `after=N` addresses rank R's own
+        # iteration clock.
         return None
     if spec.attempt is not None and _attempt() != spec.attempt:
         return None

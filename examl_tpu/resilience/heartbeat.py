@@ -34,6 +34,17 @@ from examl_tpu.resilience import faults
 
 ENV_VAR = "EXAML_HEARTBEAT_FILE"
 
+# Gang contract (resilience/supervisor.py `--launch N`): the gang
+# supervisor exports EXAML_GANG_RANKS=N and a per-rank EXAML_PROCID to
+# every rank it spawns — in REAL multi-host mode alongside
+# `--coordinator/--nprocs/--procid`, in EMULATED mode (CPU containers
+# whose jaxlib has no multi-process collectives; chaos tests) on their
+# own.  Rank 0 beats into the base path the supervisor watches; rank
+# k>0 into `<base>.p<k>` (`rank_path`), so the gang watcher can tell a
+# single straggler from a collective wedge.
+PROCID_VAR = "EXAML_PROCID"
+GANG_VAR = "EXAML_GANG_RANKS"
+
 # Minimum seconds between file writes.  Beats are called per SPR slot
 # (possibly hundreds/second on small trees); the file is for stall
 # detection on the tens-of-seconds scale, so 0.5 s of write cadence
@@ -107,6 +118,11 @@ def _publish(state: str) -> None:
         counters = {}
     payload = {"t": now, "pid": os.getpid(), "seq": _STATE["seq"],
                "state": state, "counters": counters}
+    # Atomic publish contract: write the full record to a pid-suffixed
+    # tmp and rename.  The gang watcher polls these files at 4 Hz from
+    # another process — a plain in-place write would hand it torn JSON
+    # under exactly the load a stall decision matters most
+    # (tests/test_gang.py interleaves reader and writer to pin this).
     tmp = f"{path}.tmp.{os.getpid()}"
     try:
         with open(tmp, "w") as f:
@@ -138,3 +154,42 @@ def age(path: str) -> Optional[float]:
         return max(0.0, time.time() - os.stat(path).st_mtime)
     except OSError:
         return None
+
+
+# -- gang aggregation (stdlib-only: the jax-free gang supervisor reads
+# these; parallel/launch.install_heartbeat uses the same naming) --------
+
+
+def env_rank() -> int:
+    """This process's gang rank (`EXAML_PROCID`; 0 when unset)."""
+    try:
+        return int(os.environ.get(PROCID_VAR, "0") or 0)
+    except ValueError:
+        return 0
+
+
+def env_gang_size() -> Optional[int]:
+    """The gang's world size (`EXAML_GANG_RANKS`), or None when this
+    process was not spawned by the gang supervisor."""
+    try:
+        n = int(os.environ.get(GANG_VAR, "") or 0)
+    except ValueError:
+        return None
+    return n if n > 0 else None
+
+
+def rank_path(base: str, rank: int) -> str:
+    """Rank `rank`'s heartbeat file for a gang watching `base` (rank 0
+    keeps the base path — its watcher has always watched exactly that
+    file; peers suffix `.p<rank>`)."""
+    return base if rank == 0 else f"{base}.p{rank}"
+
+
+def gang_paths(base: str, nranks: int) -> list:
+    return [rank_path(base, k) for k in range(nranks)]
+
+
+def gang_ages(base: str, nranks: int) -> list:
+    """Per-rank beat ages for the gang watcher (None = that rank has
+    never published a beat)."""
+    return [age(p) for p in gang_paths(base, nranks)]

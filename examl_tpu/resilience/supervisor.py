@@ -33,18 +33,28 @@ plugin must not be able to hang the watcher).  It:
 `EXAML_RESTART_COUNT` is exported to each attempt so fault-injection
 specs (`resilience/faults.py`) can target a single attempt — the
 mechanism that makes "crash once, then recover" chaos tests converge.
+
+`--launch N` (GangSupervisor, below) extends the same contract to
+multi-process runs: the supervisor spawns all N ranks itself, watches
+the per-rank heartbeat files, implements rank-level failure domains
+(rank death / collective wedge / single-rank straggler), and restarts
+the WHOLE gang — lockstep data parallelism makes partial survival
+useless — from the newest coordinated checkpoint, shrinking the world
+elastically when one rank keeps dying.
 """
 
 from __future__ import annotations
 
 import glob
+import hashlib
 import json
 import os
 import signal
+import socket
 import subprocess
 import sys
 import time
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 from examl_tpu.resilience import exitcause, heartbeat
 
@@ -65,7 +75,71 @@ POLL_S = 0.25
 # flag (argparse two-token form) — single-token "--flag=value" is also
 # handled by prefix match.
 _SUPERVISOR_FLAGS = {"--supervise": 0, "--supervise-retries": 1,
-                     "--supervise-stall": 1, "--supervise-backoff": 1}
+                     "--supervise-stall": 1, "--supervise-backoff": 1,
+                     "--launch": 1, "--launch-emulate": 0,
+                     "--launch-min-ranks": 1}
+
+# Elastic resume: after the SAME rank has caused this many CONSECUTIVE
+# failed attempts, the gang degrades to N-1 ranks instead of burning the
+# retry budget on a slot that keeps dying (site slices re-derive from
+# the byteFile window at parse time; checkpoint state is topology+model,
+# so a smaller world resumes the same search).
+ELASTIC_CONSECUTIVE_DEATHS = 2
+
+# Gang causes that count as a RANK DEATH (a process died) as opposed to
+# a watcher stall verdict.
+_RANK_DEATH_CAUSES = frozenset({
+    exitcause.CAUSE_CRASH, exitcause.CAUSE_OOM_KILL,
+    exitcause.CAUSE_SIGILL, exitcause.CAUSE_ERROR,
+    exitcause.CAUSE_TERMINATED})
+
+
+def backoff_delay(base: float, retry: int, key: str = "",
+                  cap: float = 60.0) -> float:
+    """Exponential restart backoff with deterministic-seeded jitter.
+
+    N gang ranks — or a future fleet of supervised jobs — all sleeping
+    the same `base * 2**k` ladder synchronize into restart storms that
+    slam a recovering device or coordinator simultaneously.  The jitter
+    fraction in [0.5, 1.0) is drawn from a blake2b hash of (key, retry),
+    so one run's delay sequence is REPRODUCIBLE (unit-testable, and a
+    resumed supervisor re-derives the same schedule) while distinct run
+    ids decorrelate across the fleet.  The cap bounds both the raw
+    exponential and the jittered result."""
+    raw = min(cap, base * (2 ** max(0, int(retry) - 1)))
+    h = int.from_bytes(hashlib.blake2b(f"{key}:{retry}".encode(),
+                                       digest_size=8).digest(), "big")
+    return min(cap, raw * (0.5 + 0.5 * h / 2.0 ** 64))
+
+
+def classify_stall(ages: List[float], stall: float) -> Optional[str]:
+    """The gang watcher's stall verdict from the LIVE ranks' beat ages.
+
+    * every rank stale  -> collective wedge (the lockstep program is
+      blocked inside a collective/dispatch on all ranks at once);
+    * one rank stale while the freshest rank is actively beating
+      (age <= stall/2) -> single-rank straggler;
+    * one rank stale while the others are MERELY AGING (> stall/2 but
+      not yet stale) -> ambiguous: a collective wedge reaches ranks an
+      allreduce apart, so keep watching — either the fresh ranks beat
+      again (straggler) or everyone crosses the line (collective).
+      Deciding early here would misread a wedge's first victim as a
+      straggler and skip the tier-degradation ladder.
+    """
+    if not ages:
+        return None
+    stale = [a > stall for a in ages]
+    if all(stale):
+        return exitcause.CAUSE_COLLECTIVE_WEDGE
+    if any(stale) and min(ages) <= stall / 2.0:
+        return exitcause.CAUSE_STRAGGLER
+    return None
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
 
 
 def child_argv(argv: List[str]) -> List[str]:
@@ -148,7 +222,42 @@ class Supervisor:
             argv.append("-R")
         return argv
 
+    # Shared retry scalars (used verbatim by both supervision loops —
+    # keep the semantics in ONE place so the single-child and gang
+    # policies can never drift):
+
+    def _escalate(self, cause: str) -> None:
+        if cause in exitcause.TIER_SUSPECT:
+            self.degrade_level = min(self.degrade_level + 1,
+                                     len(DEGRADE_LADDER) - 1)
+
+    def _retry_delay(self, retries: int) -> float:
+        return backoff_delay(self.backoff, retries, key=self.run_id)
+
+    @staticmethod
+    def _exhausted_rc(rc: Optional[int]) -> int:
+        """Final exit status when the retry budget is spent.  Signal
+        deaths surface as the conventional 128+signum (a raw negative
+        rc through sys.exit becomes an unclassifiable 247-style
+        status)."""
+        if rc is None:
+            return 1
+        return 128 - rc if rc < 0 else (rc or 1)
+
     # -- signal forwarding --------------------------------------------------
+
+    def _live_children(self) -> List[subprocess.Popen]:
+        """Children a preemption must be forwarded to (the gang
+        supervisor overrides this with its whole rank list)."""
+        return [self._child] if self._child is not None else []
+
+    def _signal_children(self, sig) -> None:
+        for child in self._live_children():
+            if child is not None and child.poll() is None:
+                try:
+                    os.killpg(child.pid, sig)
+                except (OSError, ProcessLookupError):
+                    pass
 
     def _install_signals(self):
         if not hasattr(signal, "SIGTERM"):
@@ -156,12 +265,8 @@ class Supervisor:
 
         def handler(signum, frame):
             self._preempt_signal = signal.Signals(signum).name
-            child = self._child
-            if child is not None and child.poll() is None:
-                try:                        # graceful: the child
-                    os.killpg(child.pid, signal.SIGTERM)  # checkpoints
-                except (OSError, ProcessLookupError):
-                    pass
+            # graceful: the children checkpoint and exit resumable
+            self._signal_children(signal.SIGTERM)
 
         try:
             return (signal.signal(signal.SIGTERM, handler),
@@ -314,16 +419,9 @@ class Supervisor:
                 if retries > self.max_retries:
                     self.log(f"child failed ({cause} {desc}); retry "
                              f"budget exhausted after {self.max_retries}")
-                    # Signal deaths surface as the conventional
-                    # 128+signum (a raw negative rc through sys.exit
-                    # becomes an unclassifiable 247-style status).
-                    if rc is None:
-                        return 1
-                    return 128 - rc if rc < 0 else (rc or 1)
-                if cause in exitcause.TIER_SUSPECT:
-                    self.degrade_level = min(self.degrade_level + 1,
-                                             len(DEGRADE_LADDER) - 1)
-                delay = min(60.0, self.backoff * (2 ** (retries - 1)))
+                    return self._exhausted_rc(rc)
+                self._escalate(cause)
+                delay = self._retry_delay(retries)
                 have_ckpt = bool(checkpoint_glob(self.workdir,
                                                  self.run_id))
                 self.log(
@@ -345,6 +443,11 @@ class Supervisor:
 
     # -- metrics ------------------------------------------------------------
 
+    def _resilience_blob(self) -> dict:
+        return {"attempts": self.attempts,
+                "final_pins": self._pins(),
+                "heartbeat_file": self.hb_path}
+
     def _merge_metrics(self) -> None:
         """Fold the supervisor's evidence into the child's --metrics
         snapshot (the child rewrites the file at every exit, so the
@@ -362,15 +465,374 @@ class Supervisor:
         snap.setdefault("counters", {}).update(self.counters)
         snap.setdefault("gauges", {})["resilience.degrade_level"] = \
             self.degrade_level
-        snap["resilience"] = {"attempts": self.attempts,
-                              "final_pins": self._pins(),
-                              "heartbeat_file": self.hb_path}
+        snap["resilience"] = self._resilience_blob()
         try:
             with open(self.metrics_file, "w") as f:
                 json.dump(snap, f, indent=2, sort_keys=True, default=str)
             self.log(f"metrics snapshot (merged) -> {self.metrics_file}")
         except OSError as exc:
             self.log(f"metrics merge failed ({exc})")
+
+
+class GangSupervisor(Supervisor):
+    """Rank-level failure domains for multi-process runs (`--launch N`).
+
+    ExaML's parallelism is LOCKSTEP: every rank runs the search loop in
+    unison and synchronizes through small allreduces, so one dead or
+    wedged rank stalls the whole gang indefinitely — partial survival
+    is useless, and the only sane recovery unit is the gang.  The gang
+    supervisor therefore:
+
+    * spawns all N ranks itself, each a killable process group with
+      `EXAML_PROCID=<k>` / `EXAML_GANG_RANKS=<N>` exported (plus
+      `--coordinator/--nprocs/--procid` in real distributed mode;
+      EMULATED mode — `--launch-emulate`, for CPU containers whose
+      jaxlib lacks multi-process collectives, and for the chaos tests —
+      spawns N independent single-process ranks that follow the same
+      rank contract);
+    * aggregates the per-rank heartbeat files
+      (`parallel/launch.install_heartbeat` suffixes `.p<k>`) and
+      distinguishes the failure domains: RANK DEATH (a process died),
+      COLLECTIVE WEDGE (every rank's beats went stale together — the
+      blocked-allreduce class) and SINGLE-RANK STRAGGLER (one rank
+      stale while peers actively beat) — see `classify_stall`;
+    * on any failure kills the WHOLE gang, classifies the first-failing
+      rank through the shared taxonomy, and restarts the gang from the
+      newest COORDINATED checkpoint (two-phase publish,
+      search/checkpoint.py) with the same backoff/retry/tier-pin
+      ladder as the single-process supervisor, applied gang-wide;
+    * ELASTIC RESUME: a rank that causes ELASTIC_CONSECUTIVE_DEATHS
+      failed attempts in a row shrinks the gang to N-1 ranks (down to
+      `--launch-min-ranks`) — checkpoint state is topology+model and
+      site slices re-derive at parse time, so a smaller world resumes
+      the same search instead of burning the window.
+    """
+
+    def __init__(self, argv: List[str], workdir: str, run_id: str,
+                 ranks: int, emulate: bool = False, min_ranks: int = 1,
+                 **kwargs):
+        super().__init__(argv, workdir, run_id, **kwargs)
+        self.world = max(1, int(ranks))
+        self._max_world = self.world
+        self.emulate = bool(emulate)
+        self.min_ranks = max(1, int(min_ranks))
+        self._children: List[subprocess.Popen] = []
+        self._death_streak = 0
+        self._last_dead_rank: Optional[int] = None
+
+    # -- plumbing -----------------------------------------------------------
+
+    def _live_children(self) -> List[subprocess.Popen]:
+        return list(self._children)
+
+    def _kill_gang(self) -> None:
+        for child in self._children:
+            if child.poll() is None:
+                self._kill_group(child)
+
+    def _drain_gang(self, timeout: float = 30.0) -> None:
+        """Graceful gang teardown (preemption): SIGTERM every live rank
+        so each checkpoints, then SIGKILL whatever outlives the grace."""
+        self._signal_children(signal.SIGTERM)
+        deadline = time.time() + timeout
+        while time.time() < deadline and any(
+                c.poll() is None for c in self._children):
+            time.sleep(POLL_S)
+        self._kill_gang()
+
+    def _spawn_gang(self, restarts_total: int) -> List[subprocess.Popen]:
+        argv = self._last_argv = self._attempt_argv()
+        pins = self._pins()
+        port = None if self.emulate else _free_port()
+        self.log(f"attempt {restarts_total}: starting gang of "
+                 f"{self.world} rank(s) "
+                 + ("(emulated, no process group) " if self.emulate else
+                    f"(coordinator 127.0.0.1:{port}) ")
+                 + ("(resume -R) " if "-R" in argv else "")
+                 + (f"[pins {pins}] " if pins else "")
+                 + " ".join(argv))
+        # Stale beats (including ranks beyond a shrunken world) must not
+        # mask a rank that never starts.
+        for path in heartbeat.gang_paths(self.hb_path, self._max_world):
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+        children = []
+        for k in range(self.world):
+            env = _repo_env()
+            env["EXAML_HEARTBEAT_FILE"] = self.hb_path
+            env["EXAML_RESTART_COUNT"] = str(restarts_total)
+            env[heartbeat.PROCID_VAR] = str(k)
+            env[heartbeat.GANG_VAR] = str(self.world)
+            env.update(pins)
+            rank_argv = list(argv)
+            if not self.emulate:
+                rank_argv += ["--coordinator", f"127.0.0.1:{port}",
+                              "--nprocs", str(self.world),
+                              "--procid", str(k)]
+            children.append(subprocess.Popen(
+                [sys.executable, "-m", "examl_tpu.cli.main"] + rank_argv,
+                env=env, start_new_session=True))
+        self._children = children
+        return children
+
+    # -- the gang watcher ---------------------------------------------------
+
+    def _watch_gang(self) -> Tuple[str, Optional[int], Dict[str, str]]:
+        """Wait for gang completion, first rank failure, or a stall
+        verdict; returns (cause, guilty rank or None, per-rank exits)."""
+        children = self._children
+        spawned = time.time()
+        first_beat_deadline = max(4.0 * self.stall_timeout, 900.0) \
+            if self.stall_timeout else float("inf")
+        grace = self.stall_timeout or 300.0
+        done: Dict[int, str] = {}
+
+        def exits(guilty: Optional[int], cause: str) -> Dict[str, str]:
+            out = {}
+            for k, ch in enumerate(children):
+                if k == guilty:
+                    out[f"r{k}"] = cause
+                elif k in done:
+                    out[f"r{k}"] = done[k]
+                elif ch.poll() is None:
+                    out[f"r{k}"] = "gang-killed"
+                else:
+                    out[f"r{k}"] = exitcause.classify(ch.returncode)
+            return out
+
+        while True:
+            for k, ch in enumerate(children):
+                if k in done:
+                    continue
+                rc = ch.poll()
+                if rc is None:
+                    continue
+                cause = exitcause.classify(rc)
+                if cause == exitcause.CAUSE_OK:
+                    done[k] = exitcause.CAUSE_OK
+                    continue
+                if 0 in done and done[0] == exitcause.CAUSE_OK:
+                    # Rank 0 already completed the run: a peer dying
+                    # during teardown cannot un-finish it.  Record, do
+                    # not fail the attempt.
+                    self.log(f"rank {k} exited {cause} "
+                             f"{exitcause.exit_desc(rc)} after rank 0 "
+                             "completed; ignoring")
+                    done[k] = cause
+                    continue
+                self.log(f"rank {k} died: {cause} "
+                         f"{exitcause.exit_desc(rc)}; killing the gang "
+                         "(lockstep — partial survival is useless)")
+                return cause, k, exits(k, cause)
+            if len(done) == len(children):
+                return exitcause.CAUSE_OK, None, exits(None, "")
+            if done.get(0) == exitcause.CAUSE_OK:
+                # The primary finished; lockstep peers exit within an
+                # allreduce of it.  Give them a grace window, then
+                # sweep — their outputs are per-rank scratch.
+                if not hasattr(self, "_rank0_done_t"):
+                    self._rank0_done_t = time.time()
+                if time.time() - self._rank0_done_t > grace:
+                    self.log("rank 0 completed; sweeping "
+                             f"{len(children) - len(done)} lingering "
+                             "peer(s) after the grace window")
+                    # Snapshot exits BEFORE our kill: swept peers must
+                    # read "gang-killed", not the SIGKILL we send.
+                    ex = exits(None, "")
+                    self._kill_gang()
+                    return exitcause.CAUSE_OK, None, ex
+            elif self.stall_timeout:
+                live = [k for k in range(len(children)) if k not in done]
+                ages = []
+                waiting_first_beat = False
+                for k in live:
+                    a = heartbeat.age(
+                        heartbeat.rank_path(self.hb_path, k))
+                    if a is None:
+                        # Never beaten.  Within the (generous)
+                        # first-beat deadline this rank's liveness is
+                        # UNKNOWN — it may legitimately still be in
+                        # setup/first compiles, and its lockstep peers
+                        # may already be blocked waiting on it, so NO
+                        # stall verdict can be attributed yet (calling
+                        # the blocked-but-healthy peer a straggler
+                        # would skip the tier ladder).  Past the
+                        # deadline it is maximally stale.
+                        elapsed = time.time() - spawned
+                        if elapsed <= first_beat_deadline:
+                            waiting_first_beat = True
+                            break
+                        a = elapsed
+                    ages.append(a)
+                if waiting_first_beat:
+                    time.sleep(POLL_S)
+                    continue
+                verdict = classify_stall(ages, self.stall_timeout)
+                if verdict is not None:
+                    guilty = live[max(range(len(ages)),
+                                      key=ages.__getitem__)]
+                    self.log(
+                        f"{verdict}: rank beat ages "
+                        + ", ".join(f"r{k}={a:.0f}s"
+                                    for k, a in zip(live, ages))
+                        + f" against a {self.stall_timeout:.0f}s stall "
+                        "window; killing the gang")
+                    self._inc("resilience.heartbeat_stalls")
+                    # Snapshot per-rank exits BEFORE our kill: the
+                    # still-running peers must read "gang-killed", not
+                    # the SIGKILL we are about to send them.
+                    ex = exits(guilty, verdict)
+                    self._kill_gang()
+                    return verdict, guilty, ex
+            time.sleep(POLL_S)
+
+    # -- the gang supervision loop ------------------------------------------
+
+    def run(self) -> int:
+        prior = self._install_signals()
+        retries = 0
+        preempts = 0
+        restarts_total = 0
+        try:
+            while True:
+                if self._preempt_signal is not None:
+                    self.log(f"supervisor preempted "
+                             f"({self._preempt_signal}) between "
+                             "attempts; not restarting")
+                    self._inc("resilience.preempts")
+                    return exitcause.EXIT_PREEMPTED
+                if hasattr(self, "_rank0_done_t"):
+                    del self._rank0_done_t
+                t0 = time.time()
+                self._spawn_gang(restarts_total)
+                cause, rank, rank_exits = self._watch_gang()
+                if cause == exitcause.CAUSE_PREEMPT:
+                    self._drain_gang()       # peers checkpoint, then die
+                elif cause != exitcause.CAUSE_OK:
+                    self._kill_gang()
+                rc = (self._children[rank].returncode
+                      if rank is not None
+                      else self._children[0].returncode)
+                self.attempts.append({
+                    "attempt": restarts_total, "cause": cause,
+                    "rank": rank, "rank_exits": rank_exits,
+                    "world": self.world, "returncode": rc,
+                    "seconds": round(time.time() - t0, 2),
+                    "pins": self._pins(),
+                    "resumed": "-R" in self._last_argv})
+                desc = exitcause.exit_desc(rc, none_desc="(gang-killed)")
+
+                if cause == exitcause.CAUSE_OK:
+                    self.log(f"gang run completed after {restarts_total} "
+                             "restart(s)")
+                    return 0
+                if self._preempt_signal is not None:
+                    self.log(f"supervisor preempted "
+                             f"({self._preempt_signal}); gang exited "
+                             f"{desc}; not restarting")
+                    self._inc("resilience.preempts")
+                    return exitcause.EXIT_PREEMPTED
+                if cause == exitcause.CAUSE_PREEMPT:
+                    preempts += 1
+                    self._inc("resilience.preempts")
+                    if preempts > max(10, 5 * self.max_retries):
+                        self.log("preemption storm: giving up")
+                        return exitcause.EXIT_PREEMPTED
+                    restarts_total += 1
+                    self._inc("resilience.restarts")
+                    self.log(f"rank {rank} preempted {desc}; resuming "
+                             "the gang (no retry consumed)")
+                    continue
+                if cause == exitcause.CAUSE_USAGE:
+                    self.log(f"usage error {desc}: not retryable")
+                    return rc
+                # Gang failure: count the domain, maybe shrink, retry.
+                retries += 1
+                self._inc("resilience.restarts")
+                self._inc(f"resilience.exits.{cause.replace('-', '_')}")
+                if rank is not None:
+                    self._inc("resilience.gang.rank_exits."
+                              f"r{rank}.{cause.replace('-', '_')}")
+                if cause == exitcause.CAUSE_COLLECTIVE_WEDGE:
+                    self._inc("resilience.gang.collective_wedges")
+                elif cause == exitcause.CAUSE_STRAGGLER:
+                    self._inc("resilience.gang.straggler_kills")
+                elif cause in _RANK_DEATH_CAUSES:
+                    self._inc("resilience.gang.rank_deaths")
+                # Elastic resume bookkeeping: the streak tracks one
+                # rank dying on consecutive attempts; any other outcome
+                # resets it.
+                if cause in _RANK_DEATH_CAUSES and rank is not None:
+                    if rank == self._last_dead_rank:
+                        self._death_streak += 1
+                    else:
+                        self._last_dead_rank = rank
+                        self._death_streak = 1
+                else:
+                    self._last_dead_rank = None
+                    self._death_streak = 0
+                if (self._death_streak >= ELASTIC_CONSECUTIVE_DEATHS
+                        and self.world > self.min_ranks):
+                    self.world -= 1
+                    self._inc("resilience.gang.elastic_resumes")
+                    self.log(
+                        f"elastic resume: rank {rank} died "
+                        f"{self._death_streak} consecutive time(s); "
+                        f"degrading the gang to {self.world} rank(s) "
+                        "(site slices re-derive at parse time; "
+                        "checkpoint state is world-size independent)")
+                    self._last_dead_rank = None
+                    self._death_streak = 0
+                if retries > self.max_retries:
+                    self.log(f"gang failed ({cause} {desc}); retry "
+                             f"budget exhausted after {self.max_retries}")
+                    return self._exhausted_rc(rc)
+                self._escalate(cause)
+                delay = self._retry_delay(retries)
+                have_ckpt = bool(checkpoint_glob(self.workdir,
+                                                 self.run_id))
+                self.log(
+                    f"gang failed ({cause} {desc}); retry "
+                    f"{retries}/{self.max_retries} in {delay:.1f}s "
+                    + ("from newest coordinated checkpoint"
+                       if have_ckpt else "from scratch (no checkpoint)")
+                    + (f", degradation level {self.degrade_level} "
+                       f"pins {self._pins()}"
+                       if self._pins() else ""))
+                time.sleep(delay)
+                restarts_total += 1
+        finally:
+            self._kill_gang()
+            self._restore_signals(prior)
+            self._merge_metrics()
+
+    def _resilience_blob(self) -> dict:
+        blob = super()._resilience_blob()
+        blob["gang"] = {"ranks_initial": self._max_world,
+                        "ranks_final": self.world,
+                        "emulate": self.emulate,
+                        "min_ranks": self.min_ranks}
+        return blob
+
+
+def launch_gang(argv: List[str], args, log=print) -> int:
+    """CLI entry for `--launch N`: spawn and supervise the whole gang.
+    Like `supervise()`, this parent stays jax-free — every rank is a
+    killable child process group."""
+    workdir = getattr(args, "workdir", ".") or "."
+    sup = GangSupervisor(
+        argv, workdir=workdir, run_id=args.run_id,
+        ranks=getattr(args, "launch", 1) or 1,
+        emulate=getattr(args, "launch_emulate", False),
+        min_ranks=getattr(args, "launch_min_ranks", 1),
+        max_retries=getattr(args, "supervise_retries", DEFAULT_RETRIES),
+        stall_timeout=getattr(args, "supervise_stall", DEFAULT_STALL),
+        backoff=getattr(args, "supervise_backoff", 2.0),
+        metrics_file=getattr(args, "metrics_file", None),
+        log=log)
+    return sup.run()
 
 
 def supervise(argv: List[str], args, log=print) -> int:

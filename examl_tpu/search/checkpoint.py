@@ -9,6 +9,23 @@ never overwritten, and a restart refuses mismatched command-line flags
 flags as non-portable — state is serialized as gzipped JSON: edge-list
 tree snapshots, raw model parameters (rates/freqs/alpha; eigensystems are
 recomputed), search counters, and the best-tree list.
+
+GANG RUNS (`--launch N`, resilience/supervisor.py) make the checkpoint
+cycle a TWO-PHASE COMMIT: every rank fsyncs a per-rank staging record
+into the shared workdir (rank 0 stages the full blob, peers stage tiny
+attest markers), and the published `.ckpt_N.json.gz` appears — one
+atomic rename of rank 0's fsynced blob — only once EVERY rank of the
+current attempt has staged cycle N, so a mid-cycle gang kill can never
+serve a checkpoint some rank never reached.  Stale partial cycles are
+garbage-collected at restore (`checkpoint.partial_cycles_gced`).
+
+ELASTIC RESTORE: the fingerprint records the world size (`nprocs`) but
+the mismatch check ALLOWLISTS it — site slices are re-derived from the
+byteFile window at parse time and checkpoint state is topology+model,
+so a gang may resume under a different rank count.  Anything genuinely
+sliced still hard-fails (every other fingerprint key, and a PSR
+rate-state section whose length does not tile the global pattern
+count).
 """
 
 from __future__ import annotations
@@ -30,6 +47,39 @@ from examl_tpu.tree.topology import Tree
 
 CKPT_VERSION = 1
 CKPT_MAGIC = "examl-tpu-checkpoint"
+
+# Fingerprint keys allowed to DIFFER between write and restore: the
+# world-size-independent allowlist of the elastic-resume contract.
+# Everything else is identity (alignment, partitions, model flags) and
+# hard-fails, exactly as before.
+ELASTIC_FP_KEYS = frozenset({"nprocs"})
+
+
+def _world_size() -> int:
+    """The world size recorded in fingerprints: the gang size when the
+    gang supervisor spawned us (`EXAML_GANG_RANKS`, set in BOTH real
+    and emulated gang modes), else jax's process count (1 when no
+    distributed client exists)."""
+    env = os.environ.get("EXAML_GANG_RANKS")
+    if env:
+        try:
+            return max(1, int(env))
+        except ValueError:
+            pass
+    try:
+        import jax
+        return jax.process_count()
+    except Exception:                 # noqa: BLE001 — jax-free callers
+        return 1
+
+
+def _gang_attempt() -> int:
+    """The supervisor attempt this process belongs to — stage markers
+    are attempt-stamped so a dead attempt's leftovers can never
+    complete a NEW attempt's checkpoint cycle.  One parser for
+    EXAML_RESTART_COUNT: resilience/faults.py owns it."""
+    from examl_tpu.resilience import faults
+    return faults._attempt()
 
 
 class CorruptCheckpoint(ValueError):
@@ -55,6 +105,10 @@ def _fingerprint(inst: PhyloInstance) -> dict:
         "use_median": inst.use_median,
         "per_partition_branches": inst.per_partition_branches,
         "rate_model": getattr(inst, "rate_model", "GAMMA"),
+        # Recorded for the artifact trail; ALLOWLISTED at restore
+        # (ELASTIC_FP_KEYS) — a gang may resume under a different
+        # world size.
+        "nprocs": _world_size(),
     }
 
 
@@ -116,8 +170,23 @@ def _restore_models(inst: PhyloInstance, blob: list) -> None:
             rates=np.asarray(d["rates"]), alpha=d["alpha"],
             ncat=inst.ncat, use_median=inst.use_median)
         if getattr(inst, "psr", False) and "rate_category" in d:
-            inst.rate_category[gid] = np.asarray(d["rate_category"],
-                                                 dtype=np.int32)
+            # Elastic-restore guard: PSR rate state is kept GLOBAL-width
+            # on every process (PR2's allgather contract), so a section
+            # whose length does not match the partition's global pattern
+            # count was written SLICED — genuinely world-size-dependent
+            # state the elastic allowlist must never paper over.
+            cat = np.asarray(d["rate_category"], dtype=np.int32)
+            want = int(part.global_width
+                       if getattr(part, "global_width", None) is not None
+                       else part.width)
+            if cat.size != want:
+                raise ValueError(
+                    f"checkpoint section models[{gid}].rate_category "
+                    f"carries {cat.size} sites but partition "
+                    f"'{part.name}' has {want} global patterns — a "
+                    "world-size-dependent (sliced) section cannot "
+                    "restore elastically")
+            inst.rate_category[gid] = cat
             inst.per_site_rates[gid] = np.asarray(d["per_site_rates"])
             inst.patrat[gid] = np.asarray(
                 d.get("patrat", inst.per_site_rates[gid][
@@ -136,9 +205,11 @@ class CheckpointManager:
     """
 
     FILE_RE = re.compile(r"\.ckpt_(\d+)\.json\.gz$")
+    STAGE_RE = re.compile(r"\.ckpt_(\d+)\.stage\.(blob|r\d+)$")
 
     def __init__(self, workdir: str, run_id: str,
-                 keep_last: Optional[int] = None):
+                 keep_last: Optional[int] = None,
+                 gang_rank: int = 0, gang_size: int = 1):
         self.workdir = workdir
         self.run_id = run_id
         # keep_last: prune checkpoints older than the newest N after each
@@ -147,6 +218,13 @@ class CheckpointManager:
         # per work item (e.g. -f e over thousands of trees) pass a small
         # N so disk use stays linear.
         self.keep_last = keep_last
+        # Gang runs (--launch N): `workdir` is the SHARED gang directory
+        # (every rank's manager points at the same one — lockstep keeps
+        # their cycle counters aligned), writes become the two-phase
+        # stage/publish protocol, and only published cycles are ever
+        # restored.  gang_size <= 1 is the classic single-writer path.
+        self.gang_rank = int(gang_rank)
+        self.gang_size = max(1, int(gang_size))
         os.makedirs(workdir, exist_ok=True)
         self.counter = self._max_existing() + 1
 
@@ -154,6 +232,21 @@ class CheckpointManager:
         return os.path.join(self.workdir,
                             f"ExaML_binaryCheckpoint.{self.run_id}"
                             ".ckpt_*.json.gz")
+
+    def _stage_pattern(self) -> str:
+        return os.path.join(self.workdir,
+                            f"ExaML_binaryCheckpoint.{self.run_id}"
+                            ".ckpt_*.stage.*")
+
+    def _stage_blob(self, n: int) -> str:
+        return os.path.join(self.workdir,
+                            f"ExaML_binaryCheckpoint.{self.run_id}"
+                            f".ckpt_{n}.stage.blob")
+
+    def _stage_marker(self, n: int, rank: int) -> str:
+        return os.path.join(self.workdir,
+                            f"ExaML_binaryCheckpoint.{self.run_id}"
+                            f".ckpt_{n}.stage.r{rank}")
 
     def _max_existing(self) -> int:
         nums = [int(m.group(1)) for f in glob.glob(self._pattern())
@@ -167,45 +260,56 @@ class CheckpointManager:
 
     # -- write --------------------------------------------------------------
 
+    def _fsync_file(self, path: str) -> None:
+        """fsync a CLOSED file (the gzip trailer — final deflate block +
+        CRC/ISIZE — is only written at close) BEFORE any rename: after a
+        hard kill or power loss an un-fsynced "published" file can come
+        back truncated or as a dangling directory entry, which is
+        exactly the artifact the restore fallback exists to route
+        around; the write side must not manufacture it."""
+        fd = os.open(path, os.O_RDONLY)
+        try:
+            os.fsync(fd)
+        finally:
+            os.close(fd)
+
+    def _fsync_dir(self) -> None:
+        try:                        # directory-entry durability: best
+            dirfd = os.open(self.workdir, os.O_RDONLY)  # effort on
+            try:                    # filesystems that reject dir fsync
+                os.fsync(dirfd)
+            finally:
+                os.close(dirfd)
+        except OSError:
+            pass
+
     def write(self, state: str, extras: dict, inst: PhyloInstance,
               tree: Tree, tree_dict: Optional[dict] = None) -> str:
         """tree_dict overrides the captured tree — used by quartet mode,
         where the live tree is a scaffold with asymmetric hookups that an
         edge-list snapshot cannot represent (the comprehensive model tree
-        is checkpointed instead)."""
+        is checkpointed instead).
+
+        Gang managers (gang_size > 1) take the two-phase path: rank 0
+        stages the full blob, every rank stages an attest marker, and
+        the cycle PUBLISHES (atomic rename of the fsynced blob) only
+        once all ranks of the current attempt have staged — whichever
+        rank completes the set performs the rename, so nobody blocks.
+        Returns the (eventual) published path either way."""
+        if self.gang_size > 1:
+            return self._write_gang(state, extras, inst, tree, tree_dict)
         if tree_dict is None:
             tree_dict = TreeSnapshot.capture(
                 tree, getattr(inst, "likelihood", 0.0),
                 with_key=False).to_dict()
-        blob = {
-            "magic": CKPT_MAGIC,
-            "version": CKPT_VERSION,
-            "state": state,
-            "counter": self.counter,
-            "fingerprint": _fingerprint(inst),
-            "models": _models_blob(inst),
-            "tree": tree_dict,
-            "extras": extras,
-        }
+        blob = self._blob(state, extras, inst, tree_dict)
         path = self.path_for(self.counter)
         tmp = path + ".tmp"
         from examl_tpu.resilience import faults
         try:
             with gzip.open(tmp, "wt") as f:
                 json.dump(blob, f)
-            # fsync the CLOSED tmp (the gzip trailer — final deflate
-            # block + CRC/ISIZE — is only written at close) BEFORE the
-            # rename, and fsync the DIRECTORY after: os.replace alone
-            # is only atomic against concurrent readers — after a hard
-            # kill or power loss an un-fsynced "published" checkpoint
-            # can come back truncated or as a dangling directory entry,
-            # which is exactly the artifact the restore fallback exists
-            # to route around; the write side must not manufacture it.
-            fd = os.open(tmp, os.O_RDONLY)
-            try:
-                os.fsync(fd)
-            finally:
-                os.close(fd)
+            self._fsync_file(tmp)
             # Fault seam: `checkpoint.write` fires between the tmp
             # write and the publish — a raise (default) models a full
             # disk / I/O error, `:signal=KILL` models dying mid-write:
@@ -218,33 +322,175 @@ class CheckpointManager:
             except OSError:
                 pass
             raise
-        try:                        # directory-entry durability: best
-            dirfd = os.open(self.workdir, os.O_RDONLY)  # effort on
-            try:                    # filesystems that reject dir fsync
-                os.fsync(dirfd)
-            finally:
-                os.close(dirfd)
-        except OSError:
-            pass
+        self._fsync_dir()
         self.counter += 1
         self._prune()
         return path
+
+    def _blob(self, state: str, extras: dict, inst: PhyloInstance,
+              tree_dict: dict) -> dict:
+        return {
+            "magic": CKPT_MAGIC,
+            "version": CKPT_VERSION,
+            "state": state,
+            "counter": self.counter,
+            "fingerprint": _fingerprint(inst),
+            "models": _models_blob(inst),
+            "tree": tree_dict,
+            "extras": extras,
+        }
+
+    # -- gang two-phase commit ----------------------------------------------
+
+    def _write_gang(self, state: str, extras: dict, inst: PhyloInstance,
+                    tree, tree_dict: Optional[dict]) -> str:
+        """Phase 1 of the gang checkpoint cycle: STAGE.  Rank 0 fsyncs
+        the full blob to `.ckpt_N.stage.blob`; every rank then fsyncs
+        its attest marker `.ckpt_N.stage.r<k>` (attempt-stamped, so a
+        dead attempt's leftovers can never complete a new attempt's
+        cycle).  Phase 2 (`_try_publish`) runs after staging."""
+        import time as _time
+        n = self.counter
+        from examl_tpu.resilience import faults
+        if self.gang_rank == 0:
+            if tree_dict is None:
+                tree_dict = TreeSnapshot.capture(
+                    tree, getattr(inst, "likelihood", 0.0),
+                    with_key=False).to_dict()
+            blob = self._blob(state, extras, inst, tree_dict)
+            stage = self._stage_blob(n)
+            tmp = stage + ".tmp"
+            try:
+                with gzip.open(tmp, "wt") as f:
+                    json.dump(blob, f)
+                self._fsync_file(tmp)
+                # Same seam/semantics as the single-writer path: dying
+                # here leaves the previously PUBLISHED cycle intact.
+                faults.fire("checkpoint.write")
+                os.replace(tmp, stage)
+            except OSError:
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
+                raise
+        else:
+            faults.fire("checkpoint.write")
+        marker = self._stage_marker(n, self.gang_rank)
+        tmp = f"{marker}.tmp.{os.getpid()}"
+        rec = {"rank": self.gang_rank, "cycle": n,
+               "attempt": _gang_attempt(), "pid": os.getpid(),
+               "t": _time.time()}
+        try:
+            with open(tmp, "w") as f:
+                json.dump(rec, f)
+            self._fsync_file(tmp)
+            os.replace(tmp, marker)
+        except OSError:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+        self._fsync_dir()
+        self.counter += 1
+        self._try_publish(n)
+        return self.path_for(n)
+
+    def _read_marker(self, path: str) -> Optional[dict]:
+        try:
+            with open(path) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+
+    def _try_publish(self, n: int) -> bool:
+        """Phase 2: PUBLISH cycle `n` iff rank 0's blob and EVERY rank's
+        current-attempt marker are staged.  Ranks stage each cycle
+        exactly once, so the last rank to stage is the one that sees the
+        complete set; racing publishers are harmless (the atomic rename
+        has one winner; the loser's FileNotFoundError means 'already
+        published')."""
+        blob = self._stage_blob(n)
+        if not os.path.exists(blob):
+            return False
+        attempt = _gang_attempt()
+        for k in range(self.gang_size):
+            rec = self._read_marker(self._stage_marker(n, k))
+            if rec is None or rec.get("attempt") != attempt:
+                return False
+        # Fault seam: `checkpoint.publish` fires between the completed
+        # staging phase and the publish rename — `:signal=KILL` models a
+        # gang dying exactly between the two phases; restore must fall
+        # back to the previous COMPLETE cycle.
+        from examl_tpu.resilience import faults
+        faults.fire("checkpoint.publish")
+        try:
+            os.replace(blob, self.path_for(n))
+        except FileNotFoundError:
+            return True               # a peer won the publish race
+        self._fsync_dir()
+        for k in range(self.gang_size):
+            try:
+                os.unlink(self._stage_marker(n, k))
+            except OSError:
+                pass
+        try:
+            from examl_tpu import obs
+            obs.inc("checkpoint.gang_publishes")
+        except Exception:             # noqa: BLE001
+            pass
+        self._prune()
+        return True
+
+    def gc_partial_cycles(self) -> int:
+        """Remove ALL staging leftovers (no cycle is in flight at
+        restore time) and count the distinct cycles that never
+        published — the mid-cycle-kill evidence
+        (`checkpoint.partial_cycles_gced`).  A published cycle's
+        leftover markers (publisher killed mid-unlink) are swept
+        silently: that cycle committed."""
+        published = {int(m.group(1)) for f in glob.glob(self._pattern())
+                     if (m := self.FILE_RE.search(f))}
+        partial = set()
+        for f in glob.glob(self._stage_pattern()):
+            m = self.STAGE_RE.search(f)
+            if m and int(m.group(1)) not in published:
+                partial.add(int(m.group(1)))
+            try:
+                os.unlink(f)
+            except OSError:
+                pass
+        if partial:
+            try:
+                from examl_tpu import obs
+                obs.inc("checkpoint.partial_cycles_gced", len(partial))
+                obs.log(f"EXAML: garbage-collected {len(partial)} "
+                        "partially-staged checkpoint cycle(s) "
+                        f"{sorted(partial)} (gang killed mid-cycle); "
+                        "restoring the newest COMPLETE cycle")
+            except Exception:         # noqa: BLE001
+                pass
+        return len(partial)
 
     def _prune(self) -> None:
         """Sweep EVERY on-disk index older than the newest keep_last: a
         crash between publish and prune, or a keep_last that shrank
         across a restart, leaves older orphans that a newest-expired-only
-        removal would leak forever."""
+        removal would leak forever.  Staging leftovers age out on the
+        same cutoff."""
         if self.keep_last is None:
             return
         cutoff = self.counter - self.keep_last
-        for f in glob.glob(self._pattern()):
-            m = self.FILE_RE.search(f)
-            if m and int(m.group(1)) < cutoff:
-                try:
-                    os.remove(f)
-                except FileNotFoundError:
-                    pass
+        for pattern, regex in ((self._pattern(), self.FILE_RE),
+                               (self._stage_pattern(), self.STAGE_RE)):
+            for f in glob.glob(pattern):
+                m = regex.search(f)
+                if m and int(m.group(1)) < cutoff:
+                    try:
+                        os.remove(f)
+                    except FileNotFoundError:
+                        pass
 
     def callback(self, inst: PhyloInstance, tree: Tree):
         def cb(state: str, extras: dict) -> None:
@@ -277,6 +523,17 @@ class CheckpointManager:
         if path is not None:
             return self._restore_one(inst, tree, path)
         from examl_tpu import obs
+        # Two-phase hygiene: sweep staging leftovers BEFORE choosing a
+        # cycle, so a gang killed between stage and publish resumes
+        # from the newest COMPLETE cycle and the evidence lands in
+        # `checkpoint.partial_cycles_gced`.  Rank 0 only: gang ranks
+        # restore at independent moments, and a slow peer's restore
+        # must not unlink a cycle a fast peer has already re-staged.
+        # (Residual race — a peer stages before rank 0's own restore —
+        # costs at most one unpublished interval, never correctness:
+        # the next cycle stages on every rank and publishes normally.)
+        if self.gang_rank == 0:
+            self.gc_partial_cycles()
         nums = sorted(
             (int(m.group(1)) for f in glob.glob(self._pattern())
              if (m := self.FILE_RE.search(f))), reverse=True)
@@ -316,11 +573,33 @@ class CheckpointManager:
                              f"unsupported")
         fp_now = _fingerprint(inst)
         fp_ckpt = blob["fingerprint"]
-        if fp_now != fp_ckpt:
+        hard, elastic = [], []
+        for k in sorted(set(fp_now) | set(fp_ckpt)):
+            if k in fp_now and k in fp_ckpt and fp_now[k] == fp_ckpt[k]:
+                continue
+            if k in ELASTIC_FP_KEYS:
+                # World-size-independent by design (site slices
+                # re-derive at parse time); a key missing on one side
+                # is an older-format checkpoint — tolerated silently.
+                if k in fp_now and k in fp_ckpt:
+                    elastic.append(k)
+                continue
+            hard.append(k)
+        if hard:
             raise ValueError(
                 "checkpoint was written for a different run configuration "
-                f"(checkpoint {fp_ckpt} vs current {fp_now}); restart must "
-                "use the same alignment, partitions, and model flags")
+                f"(mismatched section(s) {hard}: checkpoint {fp_ckpt} vs "
+                f"current {fp_now}); restart must use the same alignment, "
+                "partitions, and model flags")
+        if elastic:
+            from examl_tpu import obs
+            obs.inc("checkpoint.elastic_restores")
+            obs.log(
+                "EXAML: elastic restore: checkpoint written at nprocs="
+                f"{fp_ckpt.get('nprocs')}, resuming at nprocs="
+                f"{fp_now.get('nprocs')} — checkpoint state is "
+                "topology+model and site slices re-derive from the "
+                "byteFile window at parse time")
         _restore_models(inst, blob["models"])
         TreeSnapshot.from_dict(blob["tree"]).restore_into(tree)
         # -R restore: the resumed search starts from a COLD schedule

@@ -1,0 +1,165 @@
+#!/usr/bin/env python
+"""TPU tunnel probe, killable and artifact-producing (ROADMAP §1).
+
+The measurement-first chip round starts — and punctuates — with "does
+the tunnel answer?".  Probing INSIDE the round process is how windows
+get wedged: `jax.devices()` over a dead axon tunnel blocks in recv with
+no Python-level recourse, and the probing process takes the device
+handle the real work needs.  This tool probes in a KILLABLE subprocess
+(its own process group, SIGKILLed at the hard timeout) and writes a
+timestamped probe-log artifact either way, so a round that never got a
+healthy chip can PROVE it ("the artifact must carry the probe log",
+ROADMAP §1).
+
+    python tools/chip_probe.py [--timeout 180] [--log-dir probe_logs]
+                               [--platform tpu] [--tag round6]
+
+Exit codes (stable: round scripts and `--supervise` preflights branch
+on them):
+
+    0  ANSWER    the backend initialized; device list in the log
+    3  NO-ANSWER the probe child exited nonzero (no devices, import
+                 error, client init failure) — fast, honest failure
+    4  HANG      the probe child outlived --timeout and was killed —
+                 the round-4 wedge class; do NOT start backend work
+
+Shell usage:
+
+    python tools/chip_probe.py --timeout 120 || exit 1   # any failure
+    python tools/chip_probe.py; [ $? -eq 4 ] && echo "tunnel wedged"
+
+`EXAML_CHIP_PROBE_CMD` overrides the probe child's command line (shlex
+split) — the test hook that exercises the no-answer and hang paths
+without hardware.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shlex
+import signal
+import subprocess
+import sys
+import time
+
+EXIT_ANSWER = 0
+EXIT_NO_ANSWER = 3
+EXIT_HANG = 4
+
+# The child does a real (tiny) dispatch, not just device enumeration: a
+# half-wedged tunnel can enumerate devices and then hang on the first
+# program — the exact failure that must be caught BEFORE a round
+# commits to backend work.
+_PROBE_SNIPPET = r"""
+import json, sys
+import jax
+devs = jax.devices()
+import jax.numpy as jnp
+x = (jnp.ones((128, 128)) @ jnp.ones((128, 128))).block_until_ready()
+print("PROBE_JSON " + json.dumps({
+    "backend": jax.default_backend(),
+    "device_count": len(devs),
+    "devices": [str(d) for d in devs[:16]],
+    "dispatch_ok": bool(float(x[0, 0]) == 128.0),
+}))
+"""
+
+
+def probe(timeout: float = 180.0, platform: str | None = None,
+          env: dict | None = None) -> dict:
+    """Run one killable probe; returns the verdict record (the same
+    dict the log artifact carries, minus the timestamp/paths)."""
+    child_env = dict(os.environ if env is None else env)
+    if platform:
+        child_env["JAX_PLATFORMS"] = platform
+    override = child_env.get("EXAML_CHIP_PROBE_CMD")
+    cmd = (shlex.split(override) if override
+           else [sys.executable, "-c", _PROBE_SNIPPET])
+    t0 = time.time()
+    proc = subprocess.Popen(cmd, env=child_env, stdout=subprocess.PIPE,
+                            stderr=subprocess.PIPE, text=True,
+                            start_new_session=True)
+    try:
+        out, err = proc.communicate(timeout=timeout)
+        hang = False
+    except subprocess.TimeoutExpired:
+        # The whole process GROUP dies: a wedged jax client spawns
+        # helper threads/processes that must not linger holding the
+        # device handle the real round needs.
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except (OSError, ProcessLookupError):
+            proc.kill()
+        out, err = proc.communicate()
+        hang = True
+    elapsed = round(time.time() - t0, 2)
+    rec: dict = {"verdict": None, "seconds": elapsed,
+                 "returncode": proc.returncode,
+                 "timeout_s": timeout,
+                 "platform": child_env.get("JAX_PLATFORMS") or "(auto)",
+                 "stdout_tail": (out or "")[-2000:],
+                 "stderr_tail": (err or "")[-2000:]}
+    if hang:
+        rec["verdict"] = "hang"
+        return rec
+    if proc.returncode != 0:
+        rec["verdict"] = "no-answer"
+        return rec
+    rec["verdict"] = "answer"
+    for line in (out or "").splitlines():
+        if line.startswith("PROBE_JSON "):
+            try:
+                rec["probe"] = json.loads(line[len("PROBE_JSON "):])
+            except ValueError:
+                pass
+    return rec
+
+
+def write_log(rec: dict, log_dir: str, tag: str = "") -> str:
+    os.makedirs(log_dir, exist_ok=True)
+    ts = time.strftime("%Y%m%dT%H%M%S", time.gmtime())
+    name = f"chip_probe.{ts}" + (f".{tag}" if tag else "") + ".json"
+    path = os.path.join(log_dir, name)
+    with open(path, "w") as f:
+        json.dump(dict(rec, utc=ts, unix_time=time.time()), f, indent=2,
+                  sort_keys=True, default=str)
+    return path
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--timeout", type=float, default=180.0,
+                    help="hard probe deadline in seconds; the child "
+                         "process group is SIGKILLed past it "
+                         "(default 180)")
+    ap.add_argument("--log-dir", default="probe_logs",
+                    help="directory for the timestamped probe-log "
+                         "artifact (default probe_logs/)")
+    ap.add_argument("--platform", default=None,
+                    help="pin JAX_PLATFORMS for the probe child (e.g. "
+                         "tpu, cpu); default: inherit/auto-detect")
+    ap.add_argument("--tag", default="",
+                    help="suffix for the artifact name (round id)")
+    args = ap.parse_args(argv)
+
+    rec = probe(timeout=args.timeout, platform=args.platform)
+    path = write_log(rec, args.log_dir, args.tag)
+    v = rec["verdict"]
+    detail = ""
+    if v == "answer":
+        p = rec.get("probe") or {}
+        detail = (f" backend={p.get('backend')} "
+                  f"devices={p.get('device_count')}")
+    elif v == "hang":
+        detail = f" (killed after {rec['timeout_s']:.0f}s)"
+    else:
+        detail = f" (rc={rec['returncode']})"
+    print(f"chip_probe: {v}{detail} in {rec['seconds']:.1f}s -> {path}")
+    return {"answer": EXIT_ANSWER, "no-answer": EXIT_NO_ANSWER,
+            "hang": EXIT_HANG}[v]
+
+
+if __name__ == "__main__":
+    sys.exit(main())
