@@ -334,23 +334,16 @@ def _variant_step(eng, variant, entries):
 
 def _bytes_per_traversal(entries, ntips: int, patterns: int, R: int,
                          K: int, itemsize: int) -> int:
-    """HBM-traffic model for one dependency-chained traversal: per entry
-    one CLV row written, each non-tip child's CLV row read, scaler rows
-    alongside (int32/lane), tip children read 1-byte code rows.  P
-    matrices/tip tables are O(states^2) noise.  Paired with measured
-    wall time this yields achieved GB/s for the roofline comparison
-    (ROOFLINE.md: the 10x target = ~306 GB/s sustained)."""
-    clv_row = patterns * R * K * itemsize
-    sc_row = patterns * 4
-    total = 0
-    for e in entries:
-        total += clv_row + sc_row
-        for ch in (e.left, e.right):
-            if isinstance(ch, (int, np.integer)) and ch <= ntips:
-                total += patterns
-            else:
-                total += clv_row + sc_row
-    return total
+    """HBM-traffic model for one dependency-chained traversal — now the
+    SHARED definition (examl_tpu/obs/traffic.py), used identically by
+    the engine's in-run `engine.traffic_bytes` accounting and this
+    bench, so a BENCH row's achieved GB/s and a CLI run's gauge can
+    never drift (tests/test_flightrec.py pins the delegation).  Paired
+    with measured wall time this yields achieved GB/s for the roofline
+    comparison (ROOFLINE.md: the 10x target = ~306 GB/s sustained)."""
+    from examl_tpu.obs import traffic
+    return traffic.bytes_per_traversal(entries, ntips, patterns, R, K,
+                                       itemsize)
 
 
 def _host_schedule_total() -> float:
@@ -422,6 +415,16 @@ def _measure_variant(inst, tree, eng, entries, variant) -> dict:
         "peak_rss_mb": _peak_rss_mb(),
     }
     out.update(getattr(step, "program_stats", {}))
+    # Regime tag (obs/traffic.classify_regime): is this row's GB/s a
+    # bandwidth measurement or a launch-latency-floor artifact?  ops =
+    # the program's sequential dependent steps — the bounded chunk
+    # program's op count when known, else one per traversal entry (the
+    # scan tier's dependent-wave upper bound, conservative toward
+    # dispatch-bound).
+    from examl_tpu.obs import traffic
+    ops = getattr(step, "program_stats", {}).get(
+        "dispatches_per_traversal", len(entries))
+    out["regime"] = traffic.classify_regime(dt / n_steps, ops)["regime"]
     if flops is not None:
         fps = flops / dt
         # MFU vs the bf16 MXU peak (v5e ~197 TFLOP/s; override with
@@ -786,6 +789,7 @@ def _merge_metrics(results: dict, snapshot: dict) -> None:
     for name, v in (snapshot.get("counters") or {}).items():
         acc["counters"][name] = acc["counters"].get(name, 0) + v
     acc["gauges"].update(snapshot.get("gauges") or {})
+    from examl_tpu.obs import hist as _hist
     for name, t in (snapshot.get("timers") or {}).items():
         cur = acc["timers"].get(name)
         if cur is None:
@@ -798,6 +802,15 @@ def _merge_metrics(results: dict, snapshot: dict) -> None:
             for key, (a, b, pick) in zip(("min_s", "max_s"), pairs):
                 vals = [v for v in (a, b) if v is not None]
                 cur[key] = pick(vals) if vals else None
+            # Histogram buckets SUM exactly across workers; the merged
+            # quantiles recompute from the summed buckets (quantiles
+            # themselves never merge).
+            buckets = _hist.merge_bucket_dicts(cur.get("buckets"),
+                                               t.get("buckets"))
+            cur["buckets"] = buckets
+            for q in _hist.QUANTILES:
+                cur[f"p{int(q * 100)}_s"] = _hist.quantile_from_buckets(
+                    buckets, q)
 
 
 def _parse_worker_output(out: str, results: dict, notes: list):
@@ -945,6 +958,7 @@ def _assemble(results: dict, notes: list, cpu_fallback: bool) -> str:
             "tflops_per_sec": win.get("tflops_per_sec"),
             "mfu": win.get("mfu"),
             "achieved_gbps": win.get("gbps"),
+            "regime": win.get("regime"),
         })
     else:
         doc.update({"value": 0.0, "vs_baseline": 0.0})
@@ -976,7 +990,8 @@ def _assemble(results: dict, notes: list, cpu_fallback: bool) -> str:
                 f"{pre}_variant": r.get("variant"),
                 f"{pre}_tflops_per_sec": r.get("tflops_per_sec"),
                 f"{pre}_mfu": r.get("mfu"),
-                f"{pre}_achieved_gbps": r.get("gbps")})
+                f"{pre}_achieved_gbps": r.get("gbps"),
+                f"{pre}_regime": r.get("regime")})
             if "mode" in r:
                 doc[f"{pre}_mode"] = r["mode"]
             if "sev_stats" in r:

@@ -146,6 +146,14 @@ def build_argparser() -> argparse.ArgumentParser:
                     help="write Chrome-trace/Perfetto span events to "
                          "per-process JSONL files in this directory "
                          "(trace.p<procid>.jsonl; open in ui.perfetto.dev)")
+    ap.add_argument("--ledger", dest="ledger_dir", default=None,
+                    help="write the append-only run ledger (compiles, "
+                         "phases, faults, checkpoint cycles, supervisor "
+                         "decisions) to per-rank JSONL files in this "
+                         "directory (ledger.p<procid>.jsonl; rank 0 "
+                         "merges ledger.merged.jsonl at exit).  Defaults "
+                         "to the --metrics file's directory when "
+                         "--metrics is given")
     ap.add_argument("-g", dest="constraint_file", default=None,
                     help="multifurcating constraint tree")
     ap.add_argument("-p", dest="seed", type=int, default=12345,
@@ -209,6 +217,7 @@ class RunFiles:
     def phase(self, name: str):
         from examl_tpu import obs
         t0 = time.time()
+        obs.ledger_event("phase", name=name, status="begin")
         try:
             with obs.span(f"phase:{name}", cat="phase"):
                 yield
@@ -216,6 +225,8 @@ class RunFiles:
             dt = time.time() - t0
             self._phases[name] = self._phases.get(name, 0.0) + dt
             obs.observe(f"phase.{name}", dt)
+            obs.ledger_event("phase", name=name, status="end",
+                             seconds=round(dt, 3))
 
     def report_phases(self) -> None:
         # This instance's phases, merged with any `phase.*` timers other
@@ -662,6 +673,9 @@ def main(argv=None) -> int:
     _faults.reset()
     _heartbeat.reset()
     prior_faults_env = os.environ.get(_faults.ENV_VAR)
+    from examl_tpu.obs import ledger as _ledger_mod
+    _ledger_mod.reset()
+    prior_ledger_env = os.environ.get(_ledger_mod.ENV_VAR)
     for spec in (args.inject_fault or []):
         _faults.arm(spec)
     # One deadline definition for every compile monitor: the bank
@@ -711,6 +725,26 @@ def main(argv=None) -> int:
         enable_process_tracing(args.trace_events_dir, log=files.info)
     if args.profile_dir or args.trace_events_dir:
         obs.set_annotations(True)
+    # Run ledger: per-rank JSONL event stream (explicit --ledger DIR, or
+    # auto-on next to the --metrics file).  Exported so subprocesses
+    # (bank compile workers) append their events to the same timeline.
+    from examl_tpu.obs import ledger as _ledger
+    ledger_dir = _ledger.default_dir(args.ledger_dir, args.metrics_file)
+    if ledger_dir:
+        lpath = obs.enable_ledger(ledger_dir, proc=gang_rank)
+        if lpath:
+            os.environ[_ledger.ENV_VAR] = ledger_dir
+            files.info(f"run ledger -> {lpath}")
+    obs.ledger_event("run", status="start", run_id=args.run_id,
+                     mode=args.mode, restart=bool(args.restart),
+                     rank=gang_rank,
+                     attempt=os.environ.get("EXAML_RESTART_COUNT"))
+    # Periodic --metrics flush (heartbeat-ticked): a SIGKILLed child
+    # must leave its last-known counters for the supervisor to merge,
+    # not nothing (the exit-time snapshot below still wins when the
+    # run ends normally).
+    if args.metrics_file and files.primary:
+        obs.set_autoflush(args.metrics_file)
     obs.set_log_sink(files.info)
     # Preemption safety: SIGTERM/SIGINT only SET A FLAG; the search
     # loop's checkpoint cadence turns it into an emergency checkpoint
@@ -720,18 +754,24 @@ def main(argv=None) -> int:
     preempt_installed = _preempt.install(log=obs.log)
     from examl_tpu.parallel.launch import install_heartbeat
     install_heartbeat(args, log=files.info)
+    rc = 1
     try:
-        return _run(args, files)
+        rc = _run(args, files)
+        return rc
     except _preempt.PreemptCheckpointed as exc:
+        obs.ledger_event("run", status="preempted", signame=exc.signame)
         files.info(f"run preempted ({exc.signame}): emergency checkpoint "
                    "written; restart with -R to resume (a --supervise "
                    "parent resumes automatically)")
-        return _preempt.EXIT_PREEMPTED
+        rc = _preempt.EXIT_PREEMPTED
+        return rc
     finally:
         # The metrics snapshot and trace finalize must survive FAILED
         # runs — a wedged compile or mid-search crash is exactly when
         # the counters and the last completed span matter (the round-4
         # postmortem this subsystem exists for).
+        obs.ledger_event("run", status="end", rc=rc)
+        obs.set_autoflush(None)      # exit snapshot below is the record
         if args.metrics_file and files.primary:
             import json
 
@@ -745,6 +785,7 @@ def main(argv=None) -> int:
         obs.set_log_sink(None)       # don't leak this run's info file
         obs.set_annotations(False)   # no TraceAnnotation cost after the run
         obs.finalize_tracing()
+        obs.finalize_ledger()   # every rank merges; last exit completes it
         if preempt_installed:
             _preempt.uninstall()
         _heartbeat.reset()
@@ -755,6 +796,11 @@ def main(argv=None) -> int:
                 os.environ.pop(_faults.ENV_VAR, None)
             else:
                 os.environ[_faults.ENV_VAR] = prior_faults_env
+        # Ledger export is per-run likewise.
+        if prior_ledger_env is None:
+            os.environ.pop(_ledger_mod.ENV_VAR, None)
+        else:
+            os.environ[_ledger_mod.ENV_VAR] = prior_ledger_env
 
 
 def _run(args, files: RunFiles) -> int:

@@ -1,16 +1,26 @@
 """examl_tpu.obs — unified runtime observability.
 
-Three dependency-free pieces (SURVEY §5.1/§5.5: the reference's only
+Dependency-free pieces (SURVEY §5.1/§5.5: the reference's only
 instruments are gettime() deltas and ExaML_info prints):
 
 * a process-wide **metrics registry** (`obs.metrics`): counters, gauges,
-  timers — always on, dict-update cheap;
+  timers with log-bucketed latency histograms (`obs.hist`) — always on,
+  dict-update cheap — plus a heartbeat-ticked periodic snapshot flush
+  so a killed process leaves its last-known counters behind;
 * a **span tracer** (`obs.trace`): Chrome-trace/Perfetto-compatible
   per-process JSONL files, off unless `--trace-events` /
   `EXAML_TRACE_DIR` enables it, with `jax.profiler.TraceAnnotation`
   scopes so host spans line up with device profiles;
+* a **run ledger** (`obs.ledger`): append-only per-rank JSONL event
+  stream (compiles, phases, faults, checkpoint cycles, supervisor
+  decisions, probe verdicts), merged by rank 0 into one ordered gang
+  timeline at exit;
+* the shared **roofline traffic model** (`obs.traffic`): the one
+  bytes-per-traversal definition bench.py and the engine both use,
+  plus the dispatch-bound vs bandwidth-meaningful regime classifier;
 * a shared **dispatch-timing helper** (`obs.timing`) so bench.py and
-  tools/perf_lab.py measure "dispatch time" identically.
+  tools/perf_lab.py measure "dispatch time" identically (every rep
+  lands in the histogram; windows are ledger-audited).
 
 This module is the flat facade the rest of the runtime imports:
 
@@ -25,8 +35,16 @@ from __future__ import annotations
 import sys
 from typing import Callable, Optional
 
+from examl_tpu.obs import ledger as _ledger
 from examl_tpu.obs import metrics as _metrics
 from examl_tpu.obs import trace as _trace
+from examl_tpu.obs import traffic  # noqa: F401  (shared roofline model)
+from examl_tpu.obs.ledger import (  # noqa: F401
+    enable as enable_ledger, enabled as ledger_enabled,
+    event as ledger_event, finalize as finalize_ledger,
+    merge as merge_ledger, read_events as read_ledger)
+from examl_tpu.obs.metrics import (  # noqa: F401
+    maybe_autoflush, set_autoflush)
 from examl_tpu.obs.timing import time_dispatch  # noqa: F401
 from examl_tpu.obs.trace import (  # noqa: F401
     device_span, enable as enable_tracing, enabled as tracing_enabled,

@@ -35,44 +35,73 @@ Naming convention (dotted, lowercase):
                                into the --metrics snapshot at exit)
   resilience.preempt_checkpoints   emergency checkpoints before exit 75
   checkpoint.corrupt_skipped   unreadable checkpoints skipped at restore
+  engine.traffic_bytes         modeled HBM bytes moved by traversal
+                               dispatches (obs/traffic.py — the ONE
+                               bytes-per-traversal model bench.py uses)
+  engine.achieved_gbps.<tier>.<engine-tag>   windowed achieved GB/s
+                               gauge per tier (scan/chunk/pallas/
+                               whole) and engine, from the timed
+                               blocking dispatch path
+  engine.regime_dispatch_bound.<tier>.<engine-tag>   1.0 = the
+                               window's wall time sits at the
+                               launch-latency floor (dispatch-bound),
+                               0.0 = bandwidth-meaningful
+                               (obs/traffic.classify_regime)
+  chip.probe.<verdict>         chip_probe answer/no-answer/hang tallies
   faults.fired.<point>         injected faults that fired (chaos tests)
   search.spr_cycles, search.fast_cycles, search.thorough_cycles
   search.scan_dispatches, search.scan_candidates
   phase.<name>                 CLI wall-clock phases (timers)
 
 Counters accept float increments (compile_seconds accumulates wall
-seconds); timers record count/total/min/max of observed durations.
-Snapshot collectors let owners of live state (engines) publish gauges
-lazily — they run only when `snapshot()` is taken, so per-call cost is
-zero, and they hold weak references so a registry never keeps a CLV
-arena alive.
+seconds); timers record count/total/min/max of observed durations PLUS
+a log-bucketed latency histogram (obs/hist.py), so every snapshot
+carries p50/p95/p99 per timer — one slow outlier (a launch-floor
+stall, a recompile) is visible instead of vanishing into a `total_s`
+sum.  Snapshot collectors let owners of live state (engines) publish
+gauges lazily — they run only when `snapshot()` is taken, so per-call
+cost is zero, and they hold weak references so a registry never keeps
+a CLV arena alive.
 """
 
 from __future__ import annotations
 
+import json
+import os
 import threading
 import time
 from typing import Callable, Dict, Optional
 
+from examl_tpu.obs import hist as _hist
+
 
 class TimerStat:
-    __slots__ = ("count", "total", "min", "max")
+    __slots__ = ("count", "total", "min", "max", "hist")
 
     def __init__(self) -> None:
         self.count = 0
         self.total = 0.0
         self.min: Optional[float] = None
         self.max: Optional[float] = None
+        self.hist = _hist.Histogram()
 
     def observe(self, seconds: float) -> None:
         self.count += 1
         self.total += seconds
         self.min = seconds if self.min is None else min(self.min, seconds)
         self.max = seconds if self.max is None else max(self.max, seconds)
+        self.hist.observe(seconds)
 
     def as_dict(self) -> dict:
-        return {"count": self.count, "total_s": self.total,
-                "min_s": self.min, "max_s": self.max}
+        d = {"count": self.count, "total_s": self.total,
+             "min_s": self.min, "max_s": self.max}
+        # Quantiles + the raw sparse buckets: the buckets are what lets
+        # two snapshots MERGE exactly (bench worker accumulation,
+        # supervisor attempt merging) — merged quantiles recompute from
+        # summed buckets, never from quantiles.
+        d.update(self.hist.quantiles())
+        d["buckets"] = self.hist.to_dict()
+        return d
 
 
 class _TimerContext:
@@ -171,6 +200,18 @@ class MetricsRegistry:
         with self._lock:
             return dict(self._counters)
 
+    def snapshot_light(self) -> dict:
+        """Full snapshot shape WITHOUT running collectors: counters,
+        last-set gauges, timers.  The periodic-flush form — safe on the
+        search loop's clock for the same reason as snapshot_counters
+        (collectors may touch device state)."""
+        with self._lock:
+            return {
+                "counters": dict(self._counters),
+                "gauges": dict(self._gauges),
+                "timers": {n: t.as_dict() for n, t in self._timers.items()},
+            }
+
     def reset(self) -> None:
         """Clear counters/gauges/timers (collectors stay registered —
         their owners are still live)."""
@@ -185,3 +226,61 @@ _REGISTRY = MetricsRegistry()
 
 def registry() -> MetricsRegistry:
     return _REGISTRY
+
+
+# -- periodic snapshot flush -------------------------------------------------
+# `--metrics` snapshots used to be written only at exit (try/finally),
+# so a SIGKILLed / hang-killed child left NOTHING — the supervisor had
+# no last-known counters to merge for the killed attempt.  The CLI arms
+# this and the resilience heartbeat ticks it on every published beat:
+# a cheap collector-free snapshot lands on disk on a rate-limited
+# cadence (atomic tmp+rename, so the supervisor never reads torn JSON),
+# marked `"partial": true` so consumers can tell a mid-run flush from
+# the final at-exit snapshot that overwrites it.
+
+_FLUSH = {"path": None, "interval": 5.0, "last": 0.0}
+
+DEFAULT_FLUSH_INTERVAL_S = 5.0
+
+
+def set_autoflush(path: Optional[str],
+                  interval: Optional[float] = None) -> None:
+    """Arm (or, with None, disarm) the periodic snapshot flush.
+    `interval` defaults to EXAML_METRICS_FLUSH_S (else 5 s) — chaos
+    tests pin it to 0 so a warm-cache attempt killed seconds in still
+    leaves counter-bearing evidence, not just the startup flush."""
+    if interval is None:
+        try:
+            interval = float(os.environ.get("EXAML_METRICS_FLUSH_S")
+                             or DEFAULT_FLUSH_INTERVAL_S)
+        except ValueError:
+            interval = DEFAULT_FLUSH_INTERVAL_S
+    _FLUSH.update(path=path, interval=float(interval), last=0.0)
+
+
+def maybe_autoflush(force: bool = False) -> bool:
+    """Write the collector-free snapshot if armed and the cadence is
+    due; returns True when a flush happened.  Never raises: a full or
+    read-only disk must not kill the run it observes."""
+    path = _FLUSH["path"]
+    if path is None:
+        return False
+    now = time.time()
+    if not force and now - _FLUSH["last"] < _FLUSH["interval"]:
+        return False
+    _FLUSH["last"] = now
+    snap = _REGISTRY.snapshot_light()
+    snap["partial"] = True
+    snap["flushed_at"] = now
+    tmp = f"{path}.tmp.{os.getpid()}"
+    try:
+        with open(tmp, "w") as f:
+            json.dump(snap, f, sort_keys=True, default=str)
+        os.replace(tmp, path)
+    except OSError:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        return False
+    return True

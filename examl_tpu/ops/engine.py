@@ -16,13 +16,15 @@ one-CLV-per-inner-node memory scheme (`axml.h:533-629` xVector).
 
 from __future__ import annotations
 
-from typing import List, Optional, Sequence
+import time
+from typing import Dict, List, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from examl_tpu import obs
+from examl_tpu.obs import traffic as _traffic
 from examl_tpu.models.gtr import ModelParams
 from examl_tpu.ops import kernels
 from examl_tpu.ops.kernels import DeviceModels, Traversal
@@ -356,6 +358,17 @@ class LikelihoodEngine:
                              ("_jit_rate_scan", "rate_scan")):
             setattr(self, attr, self._guard_first_call(getattr(self, attr),
                                                        family))
+        # In-engine traffic accounting (obs/traffic.py, the shared
+        # roofline model): true (unpadded) pattern count for the bytes
+        # model, per-tier windowed achieved-GB/s accumulators fed by
+        # the timed blocking dispatch path (per-tier so a scan-tier
+        # recompute among chunk-tier evals can never blend into the
+        # wrong gauge), and the sequential-op count of the most recent
+        # schedule (the launch-floor term of the regime classifier).
+        self._patterns_true = int(np.sum(bucket.part_widths))
+        self._traffic_win: Dict[str, _traffic.TrafficWindow] = {}
+        self._traffic_led: Dict[str, float] = {}
+        self._last_dispatch_ops = 1
         self._register_obs()
 
     # -- observability ------------------------------------------------------
@@ -406,6 +419,109 @@ class LikelihoodEngine:
         else:
             nbytes = 0
         obs.gauge("engine.clv_arena_bytes." + self._obs_tag, nbytes)
+
+    # -- traffic accounting (shared roofline model, obs/traffic.py) ---------
+
+    def _dispatch_tier(self, fast: bool) -> str:
+        """Tier label for the traffic gauges: which program family moved
+        the bytes (scan = the wave-batched fallback; chunk = XLA fast
+        path; pallas / whole = the Mosaic tiers)."""
+        if not fast:
+            return "scan"
+        if self.pallas_whole:
+            return "whole"
+        if self.use_pallas:
+            return "pallas"
+        return "chunk"
+
+    def _tier_for(self, entries, full: bool) -> str:
+        """Tier a traversal over `entries` will actually dispatch on
+        (full + fast-eligible -> the engine's fast tier; everything
+        else — partial, PSR, -S, force_scan — runs the scan tier)."""
+        if full and len(entries):
+            if isinstance(entries, FlatTraversal):
+                fast = self._fast_eligible_flat(entries)
+            else:
+                fast = self._fast_eligible(entries)
+            return self._dispatch_tier(fast)
+        return "scan"
+
+    def _traversal_traffic_bytes(self, entries) -> int:
+        """Modeled HBM bytes of one traversal over `entries` (a
+        TraversalEntry list or a FlatTraversal) — the SAME closed form
+        bench.py's byte accounting delegates to."""
+        itemsize = np.dtype(self.storage_dtype).itemsize
+        if isinstance(entries, FlatTraversal):
+            tips = int((np.asarray(entries.left) <= self.ntips).sum()
+                       + (np.asarray(entries.right) <= self.ntips).sum())
+            return _traffic.bytes_per_traversal_counts(
+                entries.n, tips, self._patterns_true, self.R, self.K,
+                itemsize)
+        return _traffic.bytes_per_traversal(
+            entries, self.ntips, self._patterns_true, self.R, self.K,
+            itemsize)
+
+    def _scan_plan_traffic_bytes(self, plan) -> int:
+        """Modeled HBM bytes of one batched-scan dispatch: the downpass
+        orientation fixes (plain TraversalEntry rows) PLUS the uppass
+        entries, each writing one scan row and reading its two child
+        refs (a (kind, v) ref with a non-slot kind and v <= ntips is a
+        tip code row — the same tip test the shared model applies)."""
+        up = plan.up_entries
+        tips = sum(1 for e in up for kind, v in (e.left, e.right)
+                   if kind != "slot" and v <= self.ntips)
+        itemsize = np.dtype(self.storage_dtype).itemsize
+        return (self._traversal_traffic_bytes(list(plan.down_entries))
+                + _traffic.bytes_per_traversal_counts(
+                    len(up), tips, self._patterns_true, self.R, self.K,
+                    itemsize))
+
+    def _record_traffic(self, nbytes: int, tier: str,
+                        wall_s: Optional[float] = None,
+                        window: bool = True) -> None:
+        """Account one dispatch's modeled bytes; blocking full-traversal
+        dispatches (wall_s given) additionally land in the `dispatch`
+        latency histogram and — unless `window=False` — feed the
+        windowed achieved-GB/s gauge with the regime verdict, so every
+        metrics snapshot states WHICH regime its number came from.
+        Callers pass window=False when the measured wall contains a
+        first-call COMPILE: the histogram must keep it (that p99 is the
+        point), but a compile-dominated window would publish a
+        near-zero GB/s wrongly tagged bandwidth-meaningful."""
+        obs.inc("engine.traffic_bytes", nbytes)
+        if wall_s is None:
+            return
+        # The `dispatch` timer the ISSUE/bench share: wall of one
+        # BLOCKING traversal dispatch — its p99 is where a launch-floor
+        # stall or surprise recompile shows up in any CLI snapshot.
+        obs.observe("dispatch", wall_s)
+        if not window:
+            return
+        win = self._traffic_win.get(tier)
+        if win is None:
+            win = self._traffic_win[tier] = _traffic.TrafficWindow()
+        out = win.add(nbytes, wall_s, self._last_dispatch_ops)
+        if out is None:
+            return
+        gbps, regime, n = out
+        # Per-engine tagged like clv_arena_bytes/program_chunks: a
+        # DNA+AA instance has two engines whose windows close
+        # interleaved — untagged, the snapshot would quote whichever
+        # partition's verdict landed last as the run's.
+        label = f"{tier}.{self._obs_tag}"
+        obs.gauge(f"engine.achieved_gbps.{label}", round(gbps, 3))
+        obs.gauge(f"engine.regime_dispatch_bound.{label}",
+                  1.0 if regime["regime"] == "dispatch-bound" else 0.0)
+        # Ledger cadence is rate-limited per tier (the gauges above
+        # always carry the LATEST verdict): a flight recorder wants
+        # periodic bandwidth samples on the timeline, not one line per
+        # window when tests shrink the window to a single dispatch.
+        now = time.time()
+        if now - self._traffic_led.get(tier, 0.0) >= \
+                _traffic.LEDGER_EVENT_INTERVAL_S:
+            self._traffic_led[tier] = now
+            obs.ledger_event("traffic.window", tier=tier,
+                             gbps=round(gbps, 3), dispatches=n, **regime)
 
     def _sev_spec_vocab(self) -> dict:
         """PartitionSpec vocabulary + shard_map wrapper for the SEV x
@@ -611,8 +727,12 @@ class LikelihoodEngine:
 
     def _traversal_arrays(self, entries: List[TraversalEntry]) -> Traversal:
         with obs.timer("host_schedule"):
-            return self._pack_traversal(
+            tv = self._pack_traversal(
                 entries, lambda e: self.row_map[e.parent], self._gidx)
+        # Sequential dependent steps of the scan-tier program = the wave
+        # count L: the launch-floor term the regime classifier uses.
+        self._last_dispatch_ops = int(tv.parent.shape[0])
+        return tv
 
     def _gidx(self, num: int) -> int:
         """gather_child index of a node: tips by code slot, inner nodes by
@@ -651,6 +771,9 @@ class LikelihoodEngine:
         obs.inc("engine.pallas_fallbacks")
         obs.instant("pallas_fallback",
                     args={"error": f"{type(exc).__name__}: {exc}"[:300]})
+        obs.ledger_event("tier.fallback", engine=self._obs_tag,
+                         to="chunk",
+                         error=f"{type(exc).__name__}: {exc}"[:300])
         warnings.warn(
             "EXAML: Pallas kernel dispatch failed (%s: %s); permanently "
             "falling back to the XLA fast path for this engine. Set "
@@ -670,6 +793,11 @@ class LikelihoodEngine:
             return
         obs.inc("engine.dispatch_count")
         obs.inc("engine.traversal_entries", len(entries))
+        # Traffic bytes only: this path does not block on the result,
+        # so its wall time would measure submission, not the traversal
+        # — the windowed GB/s gauge is fed by the blocking fused paths.
+        self._record_traffic(self._traversal_traffic_bytes(entries),
+                             self._tier_for(entries, full))
         flat = entries if isinstance(entries, FlatTraversal) else None
         with obs.device_span("engine:traverse",
                              args={"entries": len(entries),
@@ -767,6 +895,10 @@ class LikelihoodEngine:
 
             threading.Thread(target=bark, daemon=True).start()
             t0 = _time.perf_counter()
+            # Ledger bracketing mirrors the trace span: a wedged compile
+            # leaves the unmatched "start" as the rank's last ledger
+            # event, naming the guilty family in the merged timeline.
+            obs.ledger_event("compile", family=family, status="start")
             try:
                 with obs.span(f"compile:{family}", cat="compile"):
                     # Fault seam: `compile.hang` sleeps here (default
@@ -780,9 +912,15 @@ class LikelihoodEngine:
             finally:
                 done.set()
                 dt = _time.perf_counter() - t0
+                obs.ledger_event("compile", family=family, status="end",
+                                 seconds=round(dt, 3))
                 obs.inc("engine.compile_count")
                 obs.inc("engine.compile_seconds", dt)
                 obs.inc(f"engine.compile_seconds.{family}", dt)
+                # Histogram-carrying timer alongside the counter sum:
+                # one pathological compile must be visible as a p99,
+                # not averaged into compile_seconds.
+                obs.observe(f"engine.compile_seconds.{family}", dt)
                 if bank.in_bank_phase():
                     # Banked run, bank phase: the designed place for
                     # every first call (compile time lives here, off
@@ -994,6 +1132,7 @@ class LikelihoodEngine:
         obs.gauge("engine.scan_groups" + tag, sc)
         obs.gauge("engine.dispatches_per_traversal" + tag, un + sc)
         obs.gauge("engine.chunk_blocks_total" + tag, total)
+        self._last_dispatch_ops = un + sc     # regime launch-floor term
 
     def _fast_fn_flat(self, profile, with_eval: bool):
         """Jitted chunk program over the PACKED structure + z arrays:
@@ -1163,6 +1302,11 @@ class LikelihoodEngine:
                        jnp.asarray(sched.zr, dtype=self.dtype))
 
     def _run_whole(self, entries, p_num=None, q_num=None, z=None):
+        # One fused Mosaic program = one sequential device op: the
+        # whole tier's launch floor for the regime classifier (a stale
+        # scan-tier wave count here would wrongly stamp a whole-tier
+        # bandwidth number dispatch-bound).
+        self._last_dispatch_ops = 1
         sched, args = self._whole_args(entries)
         if p_num is None:
             fn = self._whole_fn(sched.e_real, with_eval=False)
@@ -1297,6 +1441,7 @@ class LikelihoodEngine:
         obs.inc("engine.dispatch_count")
         obs.inc("engine.traversal_entries",
                 len(plan.down_entries) + len(plan.up_entries))
+        self._record_traffic(self._scan_plan_traffic_bytes(plan), "scan")
         if self.save_memory:
             self.sev.update_for_entries(plan.down_entries)
         base = self.ensure_scan_rows(len(plan.up_entries))
@@ -1336,6 +1481,7 @@ class LikelihoodEngine:
         obs.inc("engine.dispatch_count")
         obs.inc("engine.traversal_entries",
                 len(plan.down_entries) + len(plan.up_entries))
+        self._record_traffic(self._scan_plan_traffic_bytes(plan), "scan")
         if self.save_memory:
             self.sev.update_for_entries(plan.down_entries)
         base = self.ensure_scan_rows(len(plan.up_entries))
@@ -1405,10 +1551,27 @@ class LikelihoodEngine:
                           full: bool = False) -> np.ndarray:
         obs.inc("engine.dispatch_count")
         obs.inc("engine.traversal_entries", len(entries))
+        nbytes = self._traversal_traffic_bytes(entries)
+        compiles0 = obs.registry().counter("engine.compile_count")
+        t0 = time.perf_counter()
         with obs.device_span("engine:trav_eval",
                              args={"entries": len(entries),
                                    "full": bool(full)}):
-            return self._traverse_evaluate(entries, p_num, q_num, z, full)
+            out = self._traverse_evaluate(entries, p_num, q_num, z, full)
+        # This path BLOCKS (np.asarray on the lnL), so the elapsed wall
+        # covers the whole traversal: full traversals feed the windowed
+        # achieved-GB/s gauge (partial ones — a few entries around one
+        # branch — only account bytes; their wall is dominated by the
+        # root evaluation and would read as launch floor).  A dispatch
+        # whose span contained a first-call compile keeps its histogram
+        # observation but is excluded from the bandwidth window.
+        self._record_traffic(
+            nbytes, self._tier_for(entries, full),
+            wall_s=(time.perf_counter() - t0) if full and len(entries)
+            else None,
+            window=(obs.registry().counter("engine.compile_count")
+                    == compiles0))
+        return out
 
     def _traverse_evaluate(self, entries: List[TraversalEntry], p_num: int,
                            q_num: int, z: Sequence[float],
@@ -1495,6 +1658,8 @@ class LikelihoodEngine:
         obs.inc("engine.dispatch_count")
         obs.inc("engine.newton_dispatches")
         obs.inc("engine.traversal_entries", len(entries))
+        self._record_traffic(self._traversal_traffic_bytes(entries),
+                             "scan")
         if self.save_memory:
             self._sev_begin(entries)
         tv = self._traversal_arrays(entries)

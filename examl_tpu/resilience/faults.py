@@ -225,6 +225,7 @@ def fire(point: str) -> bool:
     try:                              # count fired faults when obs exists
         from examl_tpu import obs
         obs.inc(f"faults.fired.{point}")
+        obs.ledger_event("fault", point=point, action=spec.action)
         obs.log(f"EXAML: fault injection: {point} fired "
                 f"(action {spec.action})")
     except Exception:                 # noqa: BLE001 — stdlib-only callers

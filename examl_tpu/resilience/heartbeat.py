@@ -52,7 +52,7 @@ GANG_VAR = "EXAML_GANG_RANKS"
 MIN_INTERVAL = 0.5
 
 _STATE = {"path": None, "installed": False, "last": 0.0, "seq": 0,
-          "stalled": False}
+          "stalled": False, "last_state": None}
 
 
 def install(path: Optional[str] = None) -> Optional[str]:
@@ -60,14 +60,14 @@ def install(path: Optional[str] = None) -> Optional[str]:
     the active path, or None when heartbeats stay disabled."""
     path = path or os.environ.get(ENV_VAR) or None
     _STATE.update(path=path, installed=True, last=0.0, seq=0,
-                  stalled=False)
+                  stalled=False, last_state=None)
     return path
 
 
 def reset() -> None:
     """Disable + clear (one CLI run = one heartbeat stream)."""
     _STATE.update(path=None, installed=False, last=0.0, seq=0,
-                  stalled=False)
+                  stalled=False, last_state=None)
 
 
 def beat(state: str = "") -> None:
@@ -98,8 +98,29 @@ def phase_beat(state: str = "") -> None:
 
 
 def _publish(state: str) -> None:
+    # Loop-state transitions are ledger events (independent of the
+    # heartbeat file and its rate limit): the merged timeline shows
+    # FAST_SPRS -> SLOW_SPRS -> MOD_OPT with timestamps even for runs
+    # nobody supervised.
+    if state and state != _STATE["last_state"]:
+        _STATE["last_state"] = state
+        try:
+            from examl_tpu import obs
+            obs.ledger_event("search.state", state=state)
+        except Exception:             # noqa: BLE001
+            pass
     if _STATE["stalled"]:
         return
+    # Piggybacked periodic --metrics flush: the beat cadence is the
+    # liveness clock, so a killed process's snapshot is at most one
+    # flush interval stale (collector-free — see snapshot_light).
+    # Ticked BEFORE the heartbeat-file gate: an unsupervised run with
+    # --metrics but no EXAML_HEARTBEAT_FILE must flush too.
+    try:
+        from examl_tpu import obs
+        obs.maybe_autoflush()
+    except Exception:                 # noqa: BLE001
+        pass
     if not _STATE["installed"]:
         install()
     path = _STATE["path"]

@@ -56,6 +56,7 @@ import sys
 import time
 from typing import Dict, List, Optional, Tuple
 
+from examl_tpu.obs import ledger as _ledger
 from examl_tpu.resilience import exitcause, heartbeat
 
 # Degradation ladder, in escalation order (mirrors ops/bank.FALLBACK_ENV
@@ -186,6 +187,7 @@ class Supervisor:
                  stall_timeout: float = DEFAULT_STALL,
                  backoff: float = 2.0,
                  metrics_file: Optional[str] = None,
+                 ledger_dir: Optional[str] = None,
                  log=print):
         self.base_argv = child_argv(argv)
         self.workdir = workdir
@@ -196,6 +198,15 @@ class Supervisor:
         self.metrics_file = metrics_file
         self.log = lambda msg: log(f"supervise: {msg}")
         os.makedirs(workdir, exist_ok=True)
+        # Run ledger: the supervisor writes its OWN stream
+        # (`ledger.psup.jsonl` — sharing the children's directory, never
+        # their rank files) so kill/restart/elastic decisions land on
+        # the same merged timeline as the children's compile/phase
+        # events.  obs.ledger is stdlib-only, honoring the jax-free
+        # parent contract.
+        self.ledger_dir = _ledger.default_dir(ledger_dir, metrics_file)
+        if self.ledger_dir:
+            _ledger.enable(self.ledger_dir, proc="sup")
         self.hb_path = os.path.join(workdir,
                                     f".heartbeat.{run_id}.json")
         # Counters mirrored into the metrics snapshot at the end — the
@@ -348,6 +359,12 @@ class Supervisor:
                         f"{last.get('seq')}); killing the child process "
                         "group")
                     self._inc("resilience.heartbeat_stalls")
+                    _ledger.event("supervisor.kill",
+                                  reason="heartbeat-stall",
+                                  beat_age_s=(round(hb_age, 1)
+                                              if hb_age is not None
+                                              else None),
+                                  last_state=last.get("state"))
                     self._kill_group(child)
                     return exitcause.CAUSE_HANG_KILL
             time.sleep(POLL_S)
@@ -378,16 +395,21 @@ class Supervisor:
                 cause = self._watch(child)
                 self._child = None
                 rc = child.returncode
-                self.attempts.append({
+                rec = {
                     "attempt": restarts_total, "cause": cause,
                     "returncode": rc, "seconds": round(time.time() - t0, 2),
                     "pins": self._pins(),
-                    "resumed": "-R" in self._last_argv})
+                    "resumed": "-R" in self._last_argv}
+                if cause != exitcause.CAUSE_OK:
+                    rec["partial_counters"] = self._partial_counters(t0)
+                self.attempts.append(rec)
                 desc = exitcause.exit_desc(rc, none_desc="(hang-killed)")
 
                 if cause == exitcause.CAUSE_OK:
                     self.log(f"run completed after {restarts_total} "
                              "restart(s)")
+                    _ledger.event("supervisor.done",
+                                  restarts=restarts_total)
                     return 0
                 if self._preempt_signal is not None:
                     # WE were preempted: the child checkpointed (or
@@ -406,6 +428,8 @@ class Supervisor:
                         return exitcause.EXIT_PREEMPTED
                     restarts_total += 1
                     self._inc("resilience.restarts")
+                    _ledger.event("supervisor.restart", cause="preempt",
+                                  retry_consumed=False)
                     self.log(f"child preempted {desc}; resuming "
                              "(no retry consumed)")
                     continue
@@ -424,6 +448,10 @@ class Supervisor:
                 delay = self._retry_delay(retries)
                 have_ckpt = bool(checkpoint_glob(self.workdir,
                                                  self.run_id))
+                _ledger.event("supervisor.restart", cause=cause,
+                              retry=retries, resumed=have_ckpt,
+                              delay_s=round(delay, 2),
+                              pins=sorted(self._pins()))
                 self.log(
                     f"child failed ({cause} {desc}); retry "
                     f"{retries}/{self.max_retries} in {delay:.1f}s "
@@ -440,8 +468,44 @@ class Supervisor:
                 self._kill_group(child)
             self._restore_signals(prior)
             self._merge_metrics()
+            self._finalize_ledger()
 
     # -- metrics ------------------------------------------------------------
+
+    def _finalize_ledger(self) -> None:
+        """Close the supervisor's ledger stream and merge the directory
+        into one ordered timeline — the children have exited, so their
+        rank files (including a SIGKILLed attempt's crash-truncated
+        one) are complete as far as they will ever be."""
+        if self.ledger_dir:
+            # finalize() runs the directory merge itself (proc "sup"
+            # is in its auto-merge set) — one pass, no double I/O.
+            merged = _ledger.finalize()
+            if merged:
+                self.log(f"run ledger (merged) -> {merged}")
+
+    def _partial_counters(self, since: float) -> Optional[dict]:
+        """The killed attempt's last-known counters: a SIGKILLed /
+        hang-killed child never writes its exit snapshot, but the
+        heartbeat-ticked periodic flush (obs.metrics.maybe_autoflush)
+        leaves a `"partial": true` snapshot behind.  Read it NOW —
+        before the restarted attempt overwrites the file — so the
+        attempt record preserves where progress stopped.  `since` is
+        the attempt's start time: a flush stamped before it belongs to
+        a PREVIOUS attempt (this one died before its first flush) and
+        must not be attributed here."""
+        if not self.metrics_file:
+            return None
+        try:
+            with open(self.metrics_file) as f:
+                snap = json.load(f)
+        except (OSError, ValueError):
+            return None
+        if not snap.get("partial"):
+            return None               # a full exit snapshot: not a kill
+        if snap.get("flushed_at", 0) < since:
+            return None               # stale: an earlier attempt's flush
+        return snap.get("counters") or {}
 
     def _resilience_blob(self) -> dict:
         return {"attempts": self.attempts,
@@ -625,6 +689,8 @@ class GangSupervisor(Supervisor):
                 self.log(f"rank {k} died: {cause} "
                          f"{exitcause.exit_desc(rc)}; killing the gang "
                          "(lockstep — partial survival is useless)")
+                _ledger.event("supervisor.kill", reason="rank-death",
+                              rank=k, cause=cause, returncode=rc)
                 return cause, k, exits(k, cause)
             if len(done) == len(children):
                 return exitcause.CAUSE_OK, None, exits(None, "")
@@ -680,6 +746,10 @@ class GangSupervisor(Supervisor):
                         + f" against a {self.stall_timeout:.0f}s stall "
                         "window; killing the gang")
                     self._inc("resilience.heartbeat_stalls")
+                    _ledger.event("supervisor.kill", reason=verdict,
+                                  rank=guilty,
+                                  beat_ages_s=[round(a, 1)
+                                               for a in ages])
                     # Snapshot per-rank exits BEFORE our kill: the
                     # still-running peers must read "gang-killed", not
                     # the SIGKILL we are about to send them.
@@ -715,18 +785,24 @@ class GangSupervisor(Supervisor):
                 rc = (self._children[rank].returncode
                       if rank is not None
                       else self._children[0].returncode)
-                self.attempts.append({
+                rec = {
                     "attempt": restarts_total, "cause": cause,
                     "rank": rank, "rank_exits": rank_exits,
                     "world": self.world, "returncode": rc,
                     "seconds": round(time.time() - t0, 2),
                     "pins": self._pins(),
-                    "resumed": "-R" in self._last_argv})
+                    "resumed": "-R" in self._last_argv}
+                if cause != exitcause.CAUSE_OK:
+                    rec["partial_counters"] = self._partial_counters(t0)
+                self.attempts.append(rec)
                 desc = exitcause.exit_desc(rc, none_desc="(gang-killed)")
 
                 if cause == exitcause.CAUSE_OK:
                     self.log(f"gang run completed after {restarts_total} "
                              "restart(s)")
+                    _ledger.event("supervisor.done",
+                                  restarts=restarts_total,
+                                  world=self.world)
                     return 0
                 if self._preempt_signal is not None:
                     self.log(f"supervisor preempted "
@@ -742,6 +818,8 @@ class GangSupervisor(Supervisor):
                         return exitcause.EXIT_PREEMPTED
                     restarts_total += 1
                     self._inc("resilience.restarts")
+                    _ledger.event("supervisor.restart", cause="preempt",
+                                  rank=rank, retry_consumed=False)
                     self.log(f"rank {rank} preempted {desc}; resuming "
                              "the gang (no retry consumed)")
                     continue
@@ -777,6 +855,8 @@ class GangSupervisor(Supervisor):
                         and self.world > self.min_ranks):
                     self.world -= 1
                     self._inc("resilience.gang.elastic_resumes")
+                    _ledger.event("supervisor.elastic_resume",
+                                  dead_rank=rank, world=self.world)
                     self.log(
                         f"elastic resume: rank {rank} died "
                         f"{self._death_streak} consecutive time(s); "
@@ -793,6 +873,11 @@ class GangSupervisor(Supervisor):
                 delay = self._retry_delay(retries)
                 have_ckpt = bool(checkpoint_glob(self.workdir,
                                                  self.run_id))
+                _ledger.event("supervisor.restart", cause=cause,
+                              rank=rank, retry=retries,
+                              resumed=have_ckpt, world=self.world,
+                              delay_s=round(delay, 2),
+                              pins=sorted(self._pins()))
                 self.log(
                     f"gang failed ({cause} {desc}); retry "
                     f"{retries}/{self.max_retries} in {delay:.1f}s "
@@ -807,6 +892,7 @@ class GangSupervisor(Supervisor):
             self._kill_gang()
             self._restore_signals(prior)
             self._merge_metrics()
+            self._finalize_ledger()
 
     def _resilience_blob(self) -> dict:
         blob = super()._resilience_blob()
@@ -831,6 +917,7 @@ def launch_gang(argv: List[str], args, log=print) -> int:
         stall_timeout=getattr(args, "supervise_stall", DEFAULT_STALL),
         backoff=getattr(args, "supervise_backoff", 2.0),
         metrics_file=getattr(args, "metrics_file", None),
+        ledger_dir=getattr(args, "ledger_dir", None),
         log=log)
     return sup.run()
 
@@ -847,5 +934,6 @@ def supervise(argv: List[str], args, log=print) -> int:
         stall_timeout=getattr(args, "supervise_stall", DEFAULT_STALL),
         backoff=getattr(args, "supervise_backoff", 2.0),
         metrics_file=getattr(args, "metrics_file", None),
+        ledger_dir=getattr(args, "ledger_dir", None),
         log=log)
     return sup.run()

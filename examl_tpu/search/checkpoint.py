@@ -323,6 +323,12 @@ class CheckpointManager:
                 pass
             raise
         self._fsync_dir()
+        try:
+            from examl_tpu import obs
+            obs.ledger_event("checkpoint.publish", cycle=self.counter,
+                             state=state)
+        except Exception:             # noqa: BLE001
+            pass
         self.counter += 1
         self._prune()
         return path
@@ -438,6 +444,8 @@ class CheckpointManager:
         try:
             from examl_tpu import obs
             obs.inc("checkpoint.gang_publishes")
+            obs.ledger_event("checkpoint.publish", cycle=n,
+                             rank=self.gang_rank, world=self.gang_size)
         except Exception:             # noqa: BLE001
             pass
         self._prune()
@@ -465,6 +473,7 @@ class CheckpointManager:
             try:
                 from examl_tpu import obs
                 obs.inc("checkpoint.partial_cycles_gced", len(partial))
+                obs.ledger_event("checkpoint.gc", cycles=sorted(partial))
                 obs.log(f"EXAML: garbage-collected {len(partial)} "
                         "partially-staged checkpoint cycle(s) "
                         f"{sorted(partial)} (gang killed mid-cycle); "
@@ -543,6 +552,8 @@ class CheckpointManager:
                 return self._restore_one(inst, tree, p)
             except CorruptCheckpoint as exc:
                 obs.inc("checkpoint.corrupt_skipped")
+                obs.ledger_event("checkpoint.corrupt_skipped", cycle=n,
+                                 error=str(exc)[:200])
                 obs.log(f"EXAML: checkpoint {p} unreadable ({exc}); "
                         "falling back to the next-newest checkpoint")
         if nums:
@@ -608,4 +619,11 @@ class CheckpointManager:
         # explicit and counted).
         inst.invalidate_schedules()
         inst.evaluate(tree, full=True)
+        try:
+            from examl_tpu import obs
+            obs.ledger_event("checkpoint.restore",
+                             cycle=blob.get("counter"),
+                             state=blob["state"])
+        except Exception:             # noqa: BLE001
+            pass
         return {"state": blob["state"], "extras": blob["extras"]}

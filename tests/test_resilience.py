@@ -434,6 +434,11 @@ def test_e2e_sigkill_mid_search_resumes_to_same_lnl(chaos_run,
     attempt is retried on the scan tier — all asserted via obs counters
     (resilience.restarts, engine.nonfinite_retries)."""
     monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    # Flush the metrics snapshot on EVERY beat: with the warm compile
+    # cache the killed attempt lives only a few seconds, so the default
+    # 5 s cadence could leave just the counter-empty startup flush —
+    # the partial_counters assertion below needs real evidence.
+    monkeypatch.setenv("EXAML_METRICS_FLUSH_S", "0")
     rc, w, snap = _supervised(
         chaos_run, "KILL",
         ["search.kill:after=12",               # SIGKILL, attempt 0 only
@@ -447,6 +452,25 @@ def test_e2e_sigkill_mid_search_resumes_to_same_lnl(chaos_run,
     assert attempts[0]["cause"] == "oom-kill"      # external SIGKILL
     assert attempts[-1]["cause"] == "ok"
     assert attempts[-1]["resumed"]                 # -R from checkpoint
+    # Flight-recorder acceptance: the SIGKILLed attempt never wrote its
+    # exit snapshot, but the heartbeat-ticked periodic flush left a
+    # partial one, and the supervisor preserved its last-known counters
+    # in the attempt record before the retry overwrote the file.
+    pc = attempts[0]["partial_counters"]
+    assert pc and pc.get("engine.dispatch_count", 0) > 0
+    # ...and the merged ledger is the single timeline of the whole
+    # supervised run: both attempts' run-starts, the supervisor's
+    # restart decision, and the checkpoint cycles the resume used.
+    merged = os.path.join(str(chaos_run["root"]), "ledger.merged.jsonl")
+    from examl_tpu.obs import ledger as _ledger_mod
+    evs = _ledger_mod.read_events(merged)
+    assert sum(1 for e in evs if e["kind"] == "run"
+               and e.get("status") == "start") >= 2
+    assert any(e["kind"] == "supervisor.restart" for e in evs)
+    assert any(e["kind"] == "checkpoint.publish" for e in evs)
+    assert any(e["kind"] == "supervisor.done" for e in evs)
+    order = [(e["ts"], str(e["proc"]), e["seq"]) for e in evs]
+    assert order == sorted(order)
     info = open(os.path.join(w, "ExaML_info.KILL")).read()
     assert "restart from state" in info            # resumed, not redone
     assert _final_lnl(os.path.join(w, "ExaML_info.KILL")) \

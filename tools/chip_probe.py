@@ -103,9 +103,11 @@ def probe(timeout: float = 180.0, platform: str | None = None,
                  "stderr_tail": (err or "")[-2000:]}
     if hang:
         rec["verdict"] = "hang"
+        _record_obs(rec)
         return rec
     if proc.returncode != 0:
         rec["verdict"] = "no-answer"
+        _record_obs(rec)
         return rec
     rec["verdict"] = "answer"
     for line in (out or "").splitlines():
@@ -114,7 +116,28 @@ def probe(timeout: float = 180.0, platform: str | None = None,
                 rec["probe"] = json.loads(line[len("PROBE_JSON "):])
             except ValueError:
                 pass
+    _record_obs(rec)
     return rec
+
+
+def _record_obs(rec: dict) -> None:
+    """Land the verdict in the standard observability artifacts too
+    (ROADMAP §1: "the artifact must carry the probe log"): a
+    `chip.probe.<verdict>` counter for any in-process caller's
+    `--metrics` snapshot, and a ledger event — durable across the
+    process boundary whenever `EXAML_LEDGER_DIR` is set (a round
+    script's CLI runs and the standalone tool then share one
+    timeline).  obs is stdlib-only here; never let observability
+    failures mask a probe verdict."""
+    try:
+        from examl_tpu import obs
+        obs.inc(f"chip.probe.{rec['verdict']}")
+        obs.ledger_event("chip.probe", verdict=rec["verdict"],
+                         seconds=rec.get("seconds"),
+                         returncode=rec.get("returncode"),
+                         backend=(rec.get("probe") or {}).get("backend"))
+    except Exception:                            # noqa: BLE001
+        pass
 
 
 def write_log(rec: dict, log_dir: str, tag: str = "") -> str:

@@ -1,0 +1,278 @@
+#!/usr/bin/env python
+"""Render the roofline measurement report from a run's artifacts.
+
+The chip-window contract (ROADMAP §1, ROOFLINE.md "Measurement
+protocol"): every run — bench, CLI search, supervised gang — leaves a
+metrics snapshot, a run ledger and (for bench rounds) a BENCH json, and
+THIS tool turns them into the human report: per-tier achieved GB/s
+against the 306 GB/s roofline target with the dispatch-bound vs
+bandwidth-meaningful regime verdict, latency-histogram quantiles for
+the hot timers, and the merged event timeline.  `hw_round.sh` /
+BENCH_r06 rows flow through here; a window that produced artifacts but
+no report is a window half wasted.
+
+    python tools/run_report.py --metrics m.json [--ledger DIR|FILE]
+                               [--bench BENCH_r06.json] [--timeline N]
+
+stdlib-only (plus the jax-free examl_tpu.obs helpers): runnable on any
+host, including the bench parent's no-backend environment.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from examl_tpu.obs import ledger as _ledger      # noqa: E402
+from examl_tpu.obs import traffic as _traffic    # noqa: E402
+
+# Timers whose quantiles the report always surfaces when present
+# (ISSUE: dispatch, host_schedule, compile families, CLI phases).
+_KEY_TIMER_PREFIXES = ("dispatch", "host_schedule", "bench.dispatch",
+                       "bench.evaluate", "bench.newton_branch",
+                       "engine.compile_seconds.", "phase.")
+
+
+def _fmt_s(v) -> str:
+    if v is None:
+        return "-"
+    if v >= 1.0:
+        return f"{v:.2f}s"
+    if v >= 1e-3:
+        return f"{v * 1e3:.2f}ms"
+    return f"{v * 1e6:.0f}us"
+
+
+def load_metrics(path: str) -> dict:
+    with open(path) as f:
+        return json.load(f)
+
+
+def load_ledger(path: str) -> list:
+    """Events from a merged ledger file, a single rank file, or a
+    directory (merged IN MEMORY on the fly — the tool must work on a
+    crashed run's directory where rank 0 never reached its exit merge,
+    and must never write into a possibly read-only artifact dir)."""
+    if os.path.isdir(path):
+        return _ledger.read_dir(path)
+    return _ledger.read_events(path)
+
+
+# -- roofline section --------------------------------------------------------
+
+
+def tier_rows_from_metrics(snap: dict) -> list:
+    """[(tier, gbps, regime)] from the engine's windowed gauges."""
+    gauges = snap.get("gauges") or {}
+    rows = []
+    for name, gbps in sorted(gauges.items()):
+        if not name.startswith("engine.achieved_gbps."):
+            continue
+        tier = name[len("engine.achieved_gbps."):]
+        db = gauges.get(f"engine.regime_dispatch_bound.{tier}")
+        regime = ("dispatch-bound" if db else
+                  "bandwidth-meaningful" if db is not None else "?")
+        rows.append((tier, float(gbps), regime))
+    return rows
+
+
+def tier_rows_from_bench(bench: dict) -> list:
+    """[(label, gbps, regime)] from a BENCH json's per-stage fields."""
+    rows = []
+    if bench.get("achieved_gbps") is not None:
+        rows.append((f"small/{bench.get('traversal_variant', '?')}",
+                     float(bench["achieved_gbps"]),
+                     bench.get("regime", "?")))
+    for key, val in sorted(bench.items()):
+        if key.endswith("_achieved_gbps") and val is not None:
+            pre = key[:-len("_achieved_gbps")]
+            rows.append((f"{bench.get(pre + '_config', pre)}"
+                         f"/{bench.get(pre + '_variant', '?')}",
+                         float(val), bench.get(pre + "_regime", "?")))
+    return rows
+
+
+def render_roofline(out, rows: list, source: str) -> None:
+    target = _traffic.ROOFLINE_TARGET_GBPS
+    out(f"Roofline ({source}; target {target:.0f} GB/s sustained "
+        "= the >=10x goal):")
+    if not rows:
+        out("  (no achieved-GB/s evidence in this artifact)")
+        return
+    for tier, gbps, regime in rows:
+        pct = 100.0 * gbps / target
+        flag = ("" if regime == "bandwidth-meaningful"
+                else "  [NOT a bandwidth number]")
+        out(f"  {tier:24s} {gbps:10.2f} GB/s  ({pct:6.2f}% of target)"
+            f"  {regime}{flag}")
+
+
+# -- timers / histogram quantiles -------------------------------------------
+
+
+def render_timers(out, snap: dict) -> None:
+    timers = snap.get("timers") or {}
+    keys = [k for k in sorted(timers)
+            if any(k == p or k.startswith(p)
+                   for p in _KEY_TIMER_PREFIXES)]
+    if not keys:
+        return
+    out("")
+    out("Latency quantiles (log-bucketed histograms, ~6% bucket "
+        "resolution):")
+    out(f"  {'timer':32s} {'count':>8s} {'p50':>10s} {'p95':>10s} "
+        f"{'p99':>10s} {'max':>10s}")
+    for k in keys:
+        t = timers[k]
+        out(f"  {k:32s} {t.get('count', 0):>8d} "
+            f"{_fmt_s(t.get('p50_s')):>10s} {_fmt_s(t.get('p95_s')):>10s} "
+            f"{_fmt_s(t.get('p99_s')):>10s} {_fmt_s(t.get('max_s')):>10s}")
+
+
+def render_counters(out, snap: dict) -> None:
+    c = snap.get("counters") or {}
+    picks = [
+        ("engine.dispatch_count", "device dispatches"),
+        ("engine.traversal_entries", "traversal entries"),
+        ("engine.traffic_bytes", "modeled HBM bytes"),
+        ("engine.compile_count", "compiles"),
+        ("engine.compile_seconds", "compile seconds"),
+        ("engine.pallas_fallbacks", "pallas->XLA fallbacks"),
+        ("engine.watchdog_barks", "watchdog barks"),
+        ("checkpoint.gang_publishes", "gang checkpoint publishes"),
+        ("checkpoint.partial_cycles_gced", "partial cycles GCed"),
+        ("resilience.restarts", "supervisor restarts"),
+        ("resilience.heartbeat_stalls", "heartbeat stalls"),
+    ]
+    lines = [(label, c[k]) for k, label in picks if c.get(k)]
+    probes = {k.rsplit(".", 1)[1]: v for k, v in c.items()
+              if k.startswith("chip.probe.")}
+    faults = {k[len("faults.fired."):]: v for k, v in c.items()
+              if k.startswith("faults.fired.")}
+    if not (lines or probes or faults):
+        return
+    out("")
+    out("Run evidence (counters):")
+    for label, v in lines:
+        if label == "modeled HBM bytes":
+            out(f"  {label:28s} {v / 1e9:,.2f} GB")
+        else:
+            out(f"  {label:28s} {v:,.0f}")
+    if probes:
+        out("  chip probes              "
+            + "  ".join(f"{k}={int(v)}" for k, v in sorted(probes.items())))
+    if faults:
+        out("  faults fired             "
+            + "  ".join(f"{k}={int(v)}" for k, v in sorted(faults.items())))
+
+
+# -- timeline ----------------------------------------------------------------
+
+
+def _event_line(ev: dict) -> str:
+    ts = ev.get("ts", 0) / 1e6
+    kind = ev.get("kind", "?")
+    return (f"  {ts:17.6f}  p{ev.get('proc')}  {kind:24s} "
+            f"{_ledger.format_fields(ev)}")
+
+
+def _drop_matched_compile_starts(events: list) -> list:
+    """Compile start events whose end arrived are timeline noise (the
+    end carries the duration) — but an UNMATCHED start is the wedge
+    postmortem itself: the rank's last event naming the family the run
+    died compiling.  Drop only starts with a matching end."""
+    ends: dict = {}
+    for ev in events:
+        if ev.get("kind") == "compile" and ev.get("status") == "end":
+            key = (ev.get("proc"), ev.get("family"))
+            ends[key] = ends.get(key, 0) + 1
+    kept = []
+    for ev in events:
+        if ev.get("kind") == "compile" and ev.get("status") == "start":
+            key = (ev.get("proc"), ev.get("family"))
+            if ends.get(key, 0) > 0:
+                ends[key] -= 1        # matched: its end is on the line
+                continue
+        kept.append(ev)
+    return kept
+
+
+def render_timeline(out, events: list, limit: int) -> None:
+    if not events:
+        return
+    out("")
+    interesting = _drop_matched_compile_starts(events)
+    n = len(interesting)
+    out(f"Event timeline ({n} events"
+        + (f"; showing last {limit}" if n > limit else "") + "):")
+    t0 = events[0].get("ts", 0) / 1e6
+    out(f"  (epoch seconds; run began at {t0:.3f})")
+    for ev in interesting[-limit:]:
+        out(_event_line(ev))
+
+
+def render(metrics: dict, events: list, bench: dict,
+           out=print, timeline: int = 60) -> None:
+    out("=" * 72)
+    out("examl-tpu run report (roofline flight recorder)")
+    out("=" * 72)
+    if metrics.get("partial"):
+        out("NOTE: metrics snapshot is a MID-RUN flush (the process was "
+            "killed before its exit snapshot) — counters are last-known, "
+            "not final.")
+    rows = tier_rows_from_metrics(metrics)
+    if rows:
+        render_roofline(out, rows, "in-engine windowed gauges")
+    if bench:
+        if rows:
+            out("")
+        render_roofline(out, tier_rows_from_bench(bench), "BENCH rows")
+        vb = bench.get("vs_baseline")
+        out(f"  headline: {bench.get('value', 0):.3g} updates/s on "
+            f"{bench.get('backend', '?')} = {vb}x one AVX socket "
+            + ("(VALID vs baseline)" if bench.get("vs_baseline_valid")
+               else "(NOT comparable: fallback backend)"))
+        if bench.get("pallas_validated") is not None:
+            out(f"  pallas_validated: {bench['pallas_validated']}")
+    if not rows and not bench:
+        render_roofline(out, [], "no artifact")
+    render_timers(out, metrics)
+    render_counters(out, metrics)
+    # Bench artifacts embed the workers' merged registry under
+    # "metrics"; surface its timers too when the standalone snapshot
+    # lacks them.
+    if bench and not metrics.get("timers") and bench.get("metrics"):
+        render_timers(out, bench["metrics"])
+        render_counters(out, bench["metrics"])
+    render_timeline(out, events, timeline)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--metrics", default=None,
+                    help="--metrics snapshot JSON (exit or mid-run flush)")
+    ap.add_argument("--ledger", default=None,
+                    help="ledger directory, merged file, or rank file")
+    ap.add_argument("--bench", default=None,
+                    help="BENCH_r*.json artifact (the bench.py output "
+                         "line saved to a file)")
+    ap.add_argument("--timeline", type=int, default=60,
+                    help="max timeline events to print (default 60)")
+    args = ap.parse_args(argv)
+    if not (args.metrics or args.ledger or args.bench):
+        ap.error("at least one of --metrics/--ledger/--bench is required")
+    metrics = load_metrics(args.metrics) if args.metrics else {}
+    events = load_ledger(args.ledger) if args.ledger else []
+    bench = load_metrics(args.bench) if args.bench else {}
+    render(metrics, events, bench, timeline=args.timeline)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

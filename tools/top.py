@@ -1,0 +1,184 @@
+#!/usr/bin/env python
+"""Live gang view from heartbeat files and ledger tails (jax-free).
+
+`--supervise` / `--launch N` runs publish per-rank heartbeat files
+(resilience/heartbeat.py: rank 0 on the base path, rank k on
+`<base>.p<k>`) and, with `--metrics`/`--ledger`, per-rank ledger
+streams.  This tool is the operator's `top` over those artifacts: one
+row per rank (beat age, loop state, sequence number, dispatch
+counters), the roofline gauges from the newest metrics snapshot (a
+mid-run partial flush renders too), and the tail of the merged event
+timeline — refreshed in place, with `--once` printing a single frame
+for CI and round scripts.
+
+    python tools/top.py --workdir w/                 # discover + watch
+    python tools/top.py --workdir w/ --once          # one frame (CI)
+    python tools/top.py --heartbeat /path/.heartbeat.R.json --ledger d/
+
+stdlib-only by the same contract as the supervisor: heartbeat and
+ledger helpers import no backend, so this runs anywhere — including
+while the gang it watches owns the TPU.
+
+Exit codes (--once): 0 = rendered evidence, 3 = no heartbeat, ledger
+or metrics artifacts found (a smoke step should treat 3 as failure).
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from examl_tpu.obs import ledger as _ledger          # noqa: E402
+from examl_tpu.resilience import heartbeat as _hb    # noqa: E402
+
+# Heartbeat-payload counters worth a column (everything else is in the
+# metrics snapshot; the beat payload is the LIVE view).
+_RANK_COUNTERS = (("engine.dispatch_count", "dispatch"),
+                  ("engine.compile_count", "compiles"),
+                  ("search.spr_cycles", "sprs"))
+
+
+def find_heartbeats(workdir: str, base: str | None) -> list:
+    """[(rank, path)] — the supervisor's `.heartbeat.<run_id>.json`
+    base file plus any `.p<k>` rank files next to it."""
+    bases = ([base] if base else
+             sorted(p for p in glob.glob(
+                 os.path.join(workdir, ".heartbeat.*.json"))
+                 if ".tmp." not in p))
+    out = []
+    for b in bases:
+        if os.path.exists(b):
+            out.append((0, b))
+        for p in sorted(glob.glob(b + ".p*")):
+            if ".tmp." in p:
+                continue
+            try:
+                out.append((int(p.rsplit(".p", 1)[1]), p))
+            except ValueError:
+                continue
+    return out
+
+
+def find_metrics(workdir: str, explicit: str | None) -> str | None:
+    if explicit:
+        return explicit if os.path.exists(explicit) else None
+    cands = [p for p in glob.glob(os.path.join(workdir, "*.json"))
+             if not os.path.basename(p).startswith(".")]
+    best, best_t = None, -1.0
+    for p in cands:
+        try:
+            with open(p) as f:
+                snap = json.load(f)
+            t = os.stat(p).st_mtime
+        except (OSError, ValueError):
+            continue
+        if isinstance(snap, dict) and "counters" in snap and t > best_t:
+            best, best_t = p, t
+    return best
+
+
+def ledger_tail(ledger_dir: str, n: int) -> list:
+    """Last `n` events across every rank stream, merged IN MEMORY (a
+    viewer must not write into the run's artifact directory)."""
+    return _ledger.read_dir(ledger_dir)[-n:]
+
+
+def render_frame(out, workdir: str, beats: list, metrics_path,
+                 events: list) -> None:
+    out(f"examl-top  {time.strftime('%H:%M:%S')}  workdir={workdir}")
+    if beats:
+        heads = "  ".join(f"{h:>9s}" for _, h in _RANK_COUNTERS)
+        out(f"  {'rank':>4s} {'age':>7s} {'seq':>7s} {'pid':>8s} "
+            f"{heads}  state")
+        for rank, path in beats:
+            age = _hb.age(path)
+            rec = _hb.read(path) or {}
+            c = rec.get("counters") or {}
+            cols = "  ".join(f"{int(c.get(k, 0)):>9d}"
+                             for k, _ in _RANK_COUNTERS)
+            age_s = f"{age:.1f}s" if age is not None else "-"
+            out(f"  {rank:>4d} {age_s:>7s} {rec.get('seq', 0):>7d} "
+                f"{rec.get('pid', 0):>8d} {cols}  "
+                f"{rec.get('state', '') or '-'}")
+    else:
+        out("  (no heartbeat files — run is finished, unsupervised, or "
+            "not started)")
+    if metrics_path:
+        try:
+            with open(metrics_path) as f:
+                snap = json.load(f)
+        except (OSError, ValueError):
+            snap = {}
+        gauges = snap.get("gauges") or {}
+        rows = [(k[len("engine.achieved_gbps."):], v)
+                for k, v in sorted(gauges.items())
+                if k.startswith("engine.achieved_gbps.")]
+        tag = " (mid-run flush)" if snap.get("partial") else ""
+        if rows:
+            out(f"  roofline{tag}: "
+                + "  ".join(f"{t}={v:.3g}GB/s" for t, v in rows))
+        elif snap:
+            out(f"  metrics{tag}: "
+                f"{len(snap.get('counters') or {})} counters, "
+                f"{len(snap.get('timers') or {})} timers "
+                f"({os.path.basename(metrics_path)})")
+    if events:
+        out(f"  -- last {len(events)} ledger events --")
+        for ev in events:
+            ts = time.strftime("%H:%M:%S",
+                               time.localtime(ev.get("ts", 0) / 1e6))
+            out(f"  {ts} p{ev.get('proc')} {ev.get('kind', '?'):20s} "
+                f"{_ledger.format_fields(ev)}"[:110])
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--workdir", default=".",
+                    help="run directory to scan for heartbeat/ledger/"
+                         "metrics artifacts (default .)")
+    ap.add_argument("--heartbeat", default=None,
+                    help="explicit heartbeat base path (rank files "
+                         "<base>.p<k> are picked up automatically)")
+    ap.add_argument("--ledger", default=None,
+                    help="ledger directory (default: --workdir)")
+    ap.add_argument("--metrics", default=None,
+                    help="metrics snapshot (default: newest counters-"
+                         "bearing *.json in --workdir)")
+    ap.add_argument("--events", type=int, default=12,
+                    help="ledger events to tail per frame (default 12)")
+    ap.add_argument("--once", action="store_true",
+                    help="print one frame and exit (CI mode)")
+    ap.add_argument("--interval", type=float, default=2.0,
+                    help="refresh seconds in live mode (default 2)")
+    args = ap.parse_args(argv)
+    ledger_dir = args.ledger or args.workdir
+
+    def frame(out=print):
+        beats = find_heartbeats(args.workdir, args.heartbeat)
+        metrics = find_metrics(args.workdir, args.metrics)
+        events = ledger_tail(ledger_dir, args.events)
+        render_frame(out, args.workdir, beats, metrics, events)
+        return bool(beats or metrics or events)
+
+    if args.once:
+        return 0 if frame() else 3
+    try:
+        while True:
+            sys.stdout.write("\x1b[2J\x1b[H")     # clear, home
+            frame()
+            sys.stdout.flush()
+            time.sleep(max(0.2, args.interval))
+    except KeyboardInterrupt:
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
