@@ -65,6 +65,39 @@ def build_argparser() -> argparse.ArgumentParser:
                     help="memory saving for gappy alignments")
     ap.add_argument("-w", dest="workdir", default=".",
                     help="output directory")
+    ap.add_argument("-b", "--bootstrap", dest="bootstrap", type=int,
+                    default=0, metavar="K",
+                    help="fleet mode: evaluate K bootstrap weight "
+                         "replicates of the -t topology (site-"
+                         "multiplicity resampling, seeds derived from "
+                         "-p; one shared CLV pass + a batched weight "
+                         "matrix in the lnL reduction)")
+    ap.add_argument("-N", "--multi-start", dest="multi_start", type=int,
+                    default=0, metavar="K",
+                    help="fleet mode: evaluate K random starting trees "
+                         "(seeds derived from -p), batching same-"
+                         "profile topologies through one vmapped "
+                         "program; --fleet-cycles adds branch-length "
+                         "smoothing rounds per tree")
+    ap.add_argument("--serve", dest="serve", default=None, metavar="JOBS",
+                    help="fleet mode: drain a JSONL jobs file "
+                         "(fleet/jobs.py format), polling for appended "
+                         "jobs until an {\"op\": \"stop\"} line; "
+                         "--serve-poll 0 drains once and exits")
+    ap.add_argument("--serve-poll", dest="serve_poll", type=float,
+                    default=1.0,
+                    help="seconds between jobs-file polls under --serve "
+                         "(0 = drain current contents and exit; "
+                         "default 1)")
+    ap.add_argument("--fleet-batch", dest="fleet_batch", type=int,
+                    default=16,
+                    help="max jobs per batched fleet dispatch "
+                         "(padded to a power of two; default 16)")
+    ap.add_argument("--fleet-cycles", dest="fleet_cycles", type=int,
+                    default=1,
+                    help="evaluation cycles per fleet job; cycles "
+                         "after the first smooth branch lengths "
+                         "before re-scoring (default 1)")
     ap.add_argument("--bank", dest="bank", action="store_true",
                     help="ahead-of-time program banking: compile every "
                          "device-program family this run will dispatch "
@@ -484,6 +517,174 @@ def _write_per_gene_trees(args, inst, tree, files: RunFiles) -> None:
     files.info(f"Per-partition branch-length trees written to {path}")
 
 
+def run_fleet(args, inst, files: RunFiles) -> int:
+    """Fleet modes (-b K / -N K / --serve): the profile-grouped batched
+    job queue (examl_tpu/fleet/driver.py) with per-job checkpoints and
+    `-R` resume through the normal CheckpointManager stack."""
+    from examl_tpu.fleet import jobs as jobs_mod
+    from examl_tpu.fleet.driver import FleetDriver
+
+    mgr = _checkpoint_manager(args, keep_last=2)
+    start_tree = None
+    if args.tree_file:
+        start_tree = inst.tree_from_newick(_read_trees(args.tree_file)[0])
+        inst.evaluate(start_tree, full=True)
+        files.info(f"starting tree lnL {inst.likelihood:.6f}")
+        files.log_lnl(inst.likelihood)
+    resume = None
+    if args.restart:
+        scaffold = (start_tree if start_tree is not None
+                    else inst.random_tree(seed=args.seed))
+        res = mgr.restore(inst, scaffold)
+        if res is None:
+            files.info("no checkpoint found; cannot restart")
+            return 1
+        if res["state"] != "FLEET":
+            files.info(f"checkpoint state {res['state']} is not a fleet "
+                       "checkpoint")
+            return 1
+        resume = res["extras"]
+        files.info("restart from fleet checkpoint")
+    driver = FleetDriver(inst, start_tree=start_tree,
+                         batch_cap=args.fleet_batch,
+                         cycles=args.fleet_cycles, mgr=mgr,
+                         log=files.info)
+    if args.serve:
+        jobs = _serve_loop(args, driver, files, resume)
+    else:
+        if args.bootstrap:
+            jobs = jobs_mod.make_jobs("bootstrap", args.bootstrap,
+                                      args.seed, cycles=1)
+            files.info(f"fleet: {len(jobs)} bootstrap replicates of the "
+                       "starting topology")
+            if args.fleet_cycles > 1:
+                files.info("note: --fleet-cycles applies to tree jobs; "
+                           "bootstrap replicates are weights-only "
+                           "(always 1 cycle)")
+        else:
+            jobs = jobs_mod.make_jobs("start", args.multi_start,
+                                      args.seed, cycles=args.fleet_cycles)
+            files.info(f"fleet: {len(jobs)} multi-start trees, "
+                       f"{args.fleet_cycles} cycle(s) each")
+        jobs = driver.run(jobs, resume)
+    return _write_fleet_results(args, inst, files, jobs)
+
+
+def _serve_loop(args, driver, files: RunFiles, resume):
+    """Drain + poll the jobs file until a stop sentinel (or, with
+    --serve-poll 0, until the current contents are drained).  Jobs are
+    addressed by line index, so appends never re-seed earlier jobs and
+    a resume re-parses the whole file and skips finished ones."""
+    from examl_tpu import obs
+    from examl_tpu.fleet.jobs import parse_jobs_lines
+    from examl_tpu.resilience import heartbeat, preempt
+
+    processed = 0
+    stop = False
+    torn_prev = None
+    driver.jobs = []
+    while True:
+        try:
+            with open(args.serve) as f:
+                lines = f.readlines()
+        except OSError as exc:
+            files.info(f"fleet: jobs file unreadable ({exc}); stopping")
+            break
+        # A producer appending non-atomically can leave a torn final
+        # line (no trailing newline): leave it unconsumed until the
+        # next poll completes it.  A line UNCHANGED across two polls is
+        # taken as complete — a producer that stops mid-write forever
+        # (or writes its last line via `echo -n`, stop sentinel
+        # included) must not starve the queue.  In drain-once mode
+        # (poll <= 0) no more appends are coming, so take it as is.
+        if lines and args.serve_poll > 0 and not lines[-1].endswith("\n"):
+            if lines[-1] != torn_prev:
+                torn_prev = lines[-1]
+                lines = lines[:-1]
+        else:
+            torn_prev = None
+        if len(lines) > processed:
+            specs, stop_seen = parse_jobs_lines(
+                lines[processed:], args.seed,
+                default_cycles=args.fleet_cycles, start_index=processed,
+                on_error=lambda msg: files.info(
+                    f"fleet: skipping malformed jobs line ({msg})"))
+            processed = len(lines)
+            stop = stop or stop_seen
+            # Duplicate ids would alias the driver's per-job caches and
+            # collapse table/resume records: first definition wins.
+            existing = {j.job_id for j in driver.jobs}
+            fresh = []
+            for s in specs:
+                if s.job_id in existing:
+                    files.info(f"fleet: skipping duplicate job id "
+                               f"{s.job_id!r}")
+                    continue
+                existing.add(s.job_id)
+                fresh.append(s)
+            specs = fresh
+            if specs:
+                driver.jobs.extend(specs)
+                if resume:
+                    # Apply the checkpoint snapshot to the FRESH specs
+                    # only — each job sees it exactly once, as it joins
+                    # the queue.  A whole-table re-application would
+                    # regress jobs completed after the resume; a
+                    # one-shot application would miss a finished job
+                    # whose torn final line is consumed a poll later
+                    # (re-running it and double-counting job.done).
+                    driver.restore_jobs(resume, specs)
+                files.info(f"fleet: {len(specs)} new jobs from "
+                           f"{args.serve} (queue {len(driver.jobs)})")
+            obs.gauge("fleet.jobs_total", len(driver.jobs))
+        if driver.pending():
+            driver.drain()
+            continue
+        if stop:
+            files.info("fleet: stop sentinel seen and queue drained")
+            break
+        if args.serve_poll <= 0:
+            break
+        heartbeat.phase_beat("SERVE")
+        preempt.check_after_checkpoint(log=files.info)
+        time.sleep(args.serve_poll)
+    return driver.jobs
+
+
+def _write_fleet_results(args, inst, files: RunFiles, jobs) -> int:
+    """Per-job results table + result trees (rank-0 gated like every
+    other output)."""
+    ok = [j for j in jobs if j.done and not j.failed]
+    failed = [j for j in jobs if j.failed]
+    files.info(f"fleet: {len(ok)} jobs done, {len(failed)} failed, "
+               f"{len(jobs) - len(ok) - len(failed)} pending")
+    if ok:
+        best = max(ok, key=lambda j: j.lnl)
+        files.info(f"fleet: best job {best.job_id} ({best.kind}) "
+                   f"likelihood {best.lnl:.6f}")
+        files.log_lnl(best.lnl)
+    if files.primary:
+        table = os.path.join(args.workdir, f"ExaML_fleet.{args.run_id}")
+        with open(table, "w") as f:
+            f.write("# job_id kind index seed cycles lnl status\n")
+            for j in jobs:
+                lnl = f"{j.lnl:.6f}" if j.lnl is not None else "nan"
+                status = ("failed" if j.failed
+                          else "done" if j.done else "pending")
+                f.write(f"{j.job_id} {j.kind} {j.index} {j.seed} "
+                        f"{j.cycles_done}/{j.cycles} {lnl} {status}\n")
+        files.info(f"fleet results -> {table}")
+        trees = [j for j in ok if j.newick]
+        if trees:
+            tf = os.path.join(args.workdir,
+                              f"ExaML_fleetTrees.{args.run_id}")
+            with open(tf, "w") as f:
+                for j in trees:
+                    f.write(j.newick.strip() + "\n")
+            files.info(f"{len(trees)} fleet trees -> {tf}")
+    return 0 if ok or not jobs else 1
+
+
 def run_tree_evaluation(args, inst, files: RunFiles) -> int:
     """-f e / -f E: optimize model+branches on each tree in the file
     (reference `optimizeTrees`, `axml.c:2251-2356`), checkpointing with
@@ -623,6 +824,38 @@ def main(argv=None) -> int:
     if args.quartet_samples > 0 and args.quartet_file:
         ap.error('you must specify either "-r randomQuartetNumber" or '
                  '"-Y quartetGroupingFileName"')
+
+    # Fleet-mode flag hygiene: one fleet mode at a time, and the modes
+    # that conflict with the batched tier's assumptions error up front.
+    if args.bootstrap < 0 or args.multi_start < 0:
+        ap.error("-b/-N replicate counts must be positive")
+    fleet_modes = sum(bool(x) for x in (args.bootstrap, args.multi_start,
+                                        args.serve))
+    if fleet_modes > 1:
+        ap.error("-b, -N and --serve are mutually exclusive fleet modes")
+    if fleet_modes:
+        if args.mode == "q":
+            ap.error("fleet modes (-b/-N/--serve) replace the -f "
+                     "algorithm; they cannot combine with -f q")
+        if args.save_memory:
+            ap.error("fleet modes do not support -S yet (the SEV pool "
+                     "holds one arena per instance; batched arenas "
+                     "cannot stack)")
+        if args.launch is not None:
+            ap.error("fleet modes run single-gang: use --supervise for "
+                     "kill/resume supervision instead of --launch")
+        if args.bootstrap and not args.tree_file:
+            ap.error("-b bootstrap replicates resample weights on a "
+                     "fixed topology: a starting tree (-t) is required")
+        if args.nprocs is not None or args.coordinator is not None:
+            ap.error("fleet modes are single-process (the batched tier "
+                     "stacks per-job arenas on one device set); run "
+                     "one fleet per host instead of --nprocs")
+        # The batched tier owns the whole device: per-job arenas stack
+        # along a leading tree axis instead of sharding one tree's site
+        # axis (exactly BEAGLE's multi-analysis device-sharing trade).
+        if not getattr(args, "single_device", False):
+            args.single_device = True
 
     from examl_tpu.resilience import faults as _faults
     if args.inject_fault:
@@ -910,8 +1143,13 @@ def _run(args, files: RunFiles) -> int:
 
             stack.enter_context(jax.profiler.trace(args.profile_dir))
             files.info(f"profiler trace -> {args.profile_dir}")
-        with files.phase(f"inference (-f {args.mode})"):
-            if args.mode in ("d", "o"):
+        fleet = bool(args.bootstrap or args.multi_start or args.serve)
+        phase_name = ("inference (fleet)" if fleet
+                      else f"inference (-f {args.mode})")
+        with files.phase(phase_name):
+            if fleet:
+                rc = run_fleet(args, inst, files)
+            elif args.mode in ("d", "o"):
                 rc = run_search(args, inst, files)
             elif args.mode in ("e", "E"):
                 rc = run_tree_evaluation(args, inst, files)

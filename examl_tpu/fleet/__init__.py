@@ -1,0 +1,22 @@
+"""examl_tpu.fleet — many-tree batched evaluation + the job-queue driver.
+
+The service tier (ROADMAP §6): the engine evaluates one tree at a time,
+but the paper's real workload is a fleet of independent analyses —
+bootstrap replicates, multi-start searches, per-gene trees, user jobs —
+and BEAGLE 4.1 (PAPERS.md, Ayres et al.) documents the multi-analysis
+device-sharing pattern as the way small per-analysis widths fill a wide
+accelerator.  Pieces:
+
+* `seeds`     — splitmix64 per-job seed derivation (`-p`-stable across
+                restarts and elastic gang shrink);
+* `bootstrap` — site-multiplicity weight resampling + packed layout;
+* `batch`     — the batched evaluation tier: stacked per-job CLV arenas
+                vmapped through the existing fastpath segment program
+                (same-profile topologies) or the scan-tier traversal
+                (PSR / force_scan), plus the weights-only batched root
+                reduction for shared-topology bootstrap replicates;
+* `jobs`      — job specs and the JSONL jobs-file format;
+* `driver`    — the profile-grouped work queue behind `-b K`, `-N K`
+                and `--serve`, with per-job checkpoints, heartbeat
+                beats and `fleet.*` observability.
+"""

@@ -1,0 +1,355 @@
+"""Batched many-tree evaluation: a leading TREE axis over the engines.
+
+Three batched programs, all built from the engine's existing traced
+bodies so the per-job arithmetic is IDENTICAL to one-at-a-time
+evaluation (the parity contract tests/test_fleet.py pins bit-for-bit):
+
+* FAST batch — jobs whose topologies bucket to the same fastpath
+  segment profile (ops/fastpath.py: the profile IS the jit key, shared
+  across topologies of similar shape) stack their per-job CLV arenas
+  and packed schedule arrays and `jax.vmap` the engine's
+  `_run_segments_impl` + root evaluation over the leading tree axis:
+  one dispatch, J trees, zero new compiles for same-profile jobs.
+* SCAN batch — the PSR / force_scan tier vmaps the engine's
+  `_trav_eval_impl` over stacked wave-scheduled Traversal arrays
+  (the [L, W] shape is the group key).
+* WEIGHTS batch — bootstrap replicates on a FIXED topology exploit the
+  fact that pattern weights enter only at the root reduction
+  (`kernels.root_log_likelihood_from`): ONE ordinary CLV pass (shared
+  programs, cached schedules — `engine.cache_hits` is the evidence),
+  then a batched weight matrix [J, B, lane] in the lnL sum.
+
+Job counts pad to a power of two (padding jobs replay job 0, results
+discarded) so compiled variants stay O(log J) and the real/padded
+ratio is the `fleet.batch_occupancy` evidence.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from examl_tpu import obs
+from examl_tpu.ops import fastpath, kernels
+from examl_tpu.ops.kernels import Traversal
+from examl_tpu.tree.topology import Tree
+from examl_tpu.utils import bucket_len, next_pow2, z_slots
+
+
+# Batch-group key for shared-topology weight replicates: the driver's
+# grouping and the evaluator's compiled-pad bookkeeping must agree.
+WEIGHTS_GROUP = ("weights",)
+
+
+class PreparedJob:
+    """One job's host-side evaluation state: the centroid-rooted flat
+    traversal (rebuilt per cycle — branch lengths move), the cached
+    immutable fast structure (topology-keyed, reused across cycles),
+    and the batch group key."""
+
+    __slots__ = ("tree", "p", "flat", "st", "key", "z")
+
+    def __init__(self, tree, p, flat, st, key, z):
+        self.tree = tree
+        self.p = p
+        self.flat = flat
+        self.st = st          # FastStructure (fast mode) or None
+        self.key = key        # hashable batch-group key
+        self.z = z            # root-branch z [C]
+
+
+def batch_eligible(inst) -> Optional[str]:
+    """None when the instance can take the batched tier, else the
+    human-readable reason it cannot (the driver degrades to sequential
+    evaluation and says why)."""
+    if getattr(inst, "save_memory", False):
+        return "-S SEV pools hold one arena per instance"
+    for eng in inst.engines.values():
+        if eng.sharding is not None:
+            return "multi-process sharded arenas cannot stack per job"
+    return None
+
+
+class BatchEvaluator:
+    """Batched evaluation over one PhyloInstance (all engines)."""
+
+    def __init__(self, inst):
+        reason = batch_eligible(inst)
+        if reason is not None:
+            raise ValueError(f"batched tier unavailable: {reason}")
+        self.inst = inst
+        self.engines = list(inst.engines.values())
+        eng = self.engines[0]
+        self.ntips = eng.ntips
+        self.C = inst.num_branch_slots
+        # Mode is instance-wide: PSR and force_scan apply to every
+        # engine alike (instance.psr; EXAML_FAST_TRAVERSAL env).
+        self.fast = (not eng.psr and not eng.force_scan
+                     and eng.fast_slack > 0)
+        self.wave_width = eng.wave_width
+        self._jpads: dict = {}     # group key -> compiled pad sizes
+        self._weights_pass = None  # (tree id, dispatch epoch) of the
+                                   # last weights-batch CLV pass
+
+    def _pick_jpad(self, group_key, J: int) -> int:
+        """Batch pad size: the smallest ALREADY-COMPILED power of two
+        that fits, else the next power of two.  A tail batch (queue
+        drained below the cap) replays the hot program with padding
+        jobs instead of minting a fresh compile — occupancy < 1 is the
+        trade the `fleet.batch_occupancy` gauge records."""
+        compiled = self._jpads.setdefault(group_key, set())
+        fits = [p for p in compiled if p >= J]
+        jpad = min(fits) if fits else next_pow2(J)
+        compiled.add(jpad)
+        return jpad
+
+    # -- preparation / grouping --------------------------------------------
+
+    def prepare(self, tree, prev: Optional[PreparedJob] = None) -> PreparedJob:
+        """Host-side schedule state for one job (cheap on re-prepare:
+        the immutable structure survives while the topology signature
+        matches; only z refreshes)."""
+        p = tree.centroid_branch()
+        with obs.timer("host_schedule"):
+            flat = tree.flat_full_traversal(p)
+        z = np.asarray(z_slots(p.z, self.C), dtype=np.float64)
+        if not self.fast:
+            key = ("scan",) + self._scan_shape(flat)
+            return PreparedJob(tree, p, flat, None, key, z)
+        if prev is not None and prev.st is not None \
+                and prev.flat.topo_key == flat.topo_key:
+            st = prev.st
+        else:
+            with obs.timer("host_schedule"):
+                st = fastpath.build_structure(flat, self.ntips)
+        return PreparedJob(tree, p, flat, st, ("fast", st.profile), z)
+
+    def _scan_shape(self, flat) -> tuple:
+        """The scan tier's compiled [L, W] traversal shape — the batch
+        group key for PSR/force_scan jobs (mirrors the wave chunking in
+        engine._pack_traversal)."""
+        sizes = np.asarray(flat.wave_sizes)
+        W = min(next_pow2(int(sizes.max())), self.wave_width) if len(sizes) \
+            else 1
+        nwaves = int(np.sum((sizes + W - 1) // W))
+        return (bucket_len(nwaves), W)
+
+    # -- batched programs (engine shared-cache entries) ---------------------
+
+    def _fast_fn(self, eng, profile, jpad: int):
+        key = ("fleet", profile, jpad, self.C)
+        fn = eng.cache_get(key)
+        if fn is not None:
+            return fn
+
+        def body(clv, scaler, base, lidx, ridx, lcode, rcode, zl, zr,
+                 p_idx, q_idx, zv, dm, block_part, weights, tips):
+            clv, scaler = eng._run_segments_impl(
+                dm, block_part, tips, clv, scaler, profile, base, lidx,
+                ridx, lcode, rcode, zl, zr)
+            return kernels.root_log_likelihood(
+                dm, block_part, weights, tips, clv, scaler, p_idx, q_idx,
+                zv, eng.num_parts, eng.scale_exp, eng.ntips, None)
+
+        # No donation: the body returns only the lnL rows, so the
+        # stacked arenas have no donatable destination (jax would warn
+        # "donated buffers were not usable" on every dispatch).
+        vb = jax.vmap(body, in_axes=(0,) * 12 + (None,) * 4)
+        return eng.cache_put(key, jax.jit(vb))
+
+    def _scan_fn(self, eng, shape, jpad: int):
+        key = ("fleetscan", shape, jpad, self.C)
+        fn = eng.cache_get(key)
+        if fn is not None:
+            return fn
+
+        def body(buf, scaler, tv, p_idx, q_idx, zv, dm, block_part,
+                 weights, tips, sr):
+            return eng._trav_eval_impl(buf, scaler, (), tv, p_idx, q_idx,
+                                       zv, dm, block_part, weights, tips,
+                                       sr)
+
+        vb = jax.vmap(body,
+                      in_axes=(0, 0, Traversal(0, 0, 0, 0, 0), 0, 0, 0,
+                               None, None, None, None, None))
+        return eng.cache_put(key, jax.jit(vb, donate_argnums=(0, 1)))
+
+    def _weights_fn(self, eng, jpad: int):
+        key = ("fleetw", jpad)
+        fn = eng.cache_get(key)
+        if fn is not None:
+            return fn
+
+        def body(w, clv, scaler, p_idx, q_idx, zv, dm, block_part, tips,
+                 sr):
+            return kernels.root_log_likelihood(
+                dm, block_part, w, tips, clv, scaler, p_idx, q_idx, zv,
+                eng.num_parts, eng.scale_exp, eng.ntips, sr)
+
+        # The engine's LIVE arena rides along un-donated (it is read by
+        # every job and must survive the dispatch).
+        vb = jax.vmap(body, in_axes=(0,) + (None,) * 9)
+        return eng.cache_put(key, jax.jit(vb))
+
+    # -- dispatch ------------------------------------------------------------
+
+    @staticmethod
+    def _pad_stack(arrs: Sequence, jpad: int):
+        """Stack per-job leaves, padding to jpad by replaying job 0."""
+        arrs = list(arrs) + [arrs[0]] * (jpad - len(arrs))
+        return jnp.stack([jnp.asarray(a) for a in arrs])
+
+    def _gidx_st(self, st, num: int) -> int:
+        if num <= self.ntips:
+            return num - 1
+        return self.ntips + int(st.row_of[num])
+
+    def _gidx_identity(self, num: int) -> int:
+        """gather index against the INITIAL arena layout (row = node
+        number - ntips - 1): the batch arenas are fresh per dispatch, so
+        the identity map is always valid — and it matches a scan-tier
+        engine's own never-permuted row_map, keeping the batched scan
+        program's arithmetic identical to one-at-a-time."""
+        if num <= self.ntips:
+            return num - 1
+        return self.ntips + (num - self.ntips - 1)
+
+    def eval_batch(self, jobs: List[PreparedJob]) -> np.ndarray:
+        """Per-job per-partition lnL [J, M] for one same-key batch, in
+        ONE device dispatch per engine."""
+        assert jobs, "empty batch"
+        assert len({j.key for j in jobs}) == 1, \
+            "batch mixes job groups (driver bug)"
+        J = len(jobs)
+        jpad = self._pick_jpad(jobs[0].key, J)
+        M = len(self.inst.models)
+        per_part = np.full((J, M), np.nan)
+        obs.gauge("fleet.batch_occupancy", J / jpad)
+        for eng in self.engines:
+            vals = (self._eval_fast(eng, jobs, jpad) if self.fast
+                    else self._eval_scan(eng, jobs, jpad))
+            for li, gid in enumerate(eng.bucket.part_ids):
+                per_part[:, gid] = vals[:J, li]
+        return per_part
+
+    def _batch_arenas(self, eng, jpad: int):
+        rows = eng.n_inner + eng.fast_slack + 1
+        clv = jnp.zeros((jpad, rows, eng.B, eng.lane, eng.R, eng.K),
+                        eng.storage_dtype)
+        scaler = jnp.zeros((jpad, rows, eng.B, eng.lane), jnp.int32)
+        return clv, scaler
+
+    def _eval_fast(self, eng, jobs: List[PreparedJob], jpad: int):
+        profile = jobs[0].st.profile
+        with obs.timer("host_schedule"):
+            zs = [fastpath.refresh_z(j.st, j.flat, self.C, eng.dtype)
+                  for j in jobs]
+        fn = self._fast_fn(eng, profile, jpad)
+        clv, scaler = self._batch_arenas(eng, jpad)
+        pq = [(self._gidx_st(j.st, j.p.number),
+               self._gidx_st(j.st, j.p.back.number)) for j in jobs]
+        obs.inc("engine.dispatch_count")
+        with obs.device_span("fleet:batch_eval",
+                             args={"jobs": len(jobs), "jpad": jpad}):
+            out = fn(clv, scaler,
+                     self._pad_stack([j.st.base for j in jobs], jpad),
+                     self._pad_stack([j.st.lidx for j in jobs], jpad),
+                     self._pad_stack([j.st.ridx for j in jobs], jpad),
+                     self._pad_stack([j.st.lcode for j in jobs], jpad),
+                     self._pad_stack([j.st.rcode for j in jobs], jpad),
+                     self._pad_stack([z[0] for z in zs], jpad),
+                     self._pad_stack([z[1] for z in zs], jpad),
+                     self._pad_stack([jnp.int32(p) for p, _ in pq], jpad),
+                     self._pad_stack([jnp.int32(q) for _, q in pq], jpad),
+                     self._pad_stack(
+                         [jnp.asarray(j.z, eng.dtype) for j in jobs], jpad),
+                     eng.models, eng.block_part, eng.weights, eng.tips)
+        return np.asarray(out)
+
+    def _eval_scan(self, eng, jobs: List[PreparedJob], jpad: int):
+        tvs = []
+        with obs.timer("host_schedule"):
+            for j in jobs:
+                entries = j.flat.to_entries()
+                tvs.append(eng._pack_traversal(
+                    entries,
+                    lambda e: e.parent - self.ntips - 1,
+                    self._gidx_identity))
+        shapes = {tuple(tv.parent.shape) for tv in tvs}
+        assert len(shapes) == 1, f"scan batch mixes shapes {shapes}"
+        fn = self._scan_fn(eng, shapes.pop(), jpad)
+        clv, scaler = self._batch_arenas(eng, jpad)
+        tv = Traversal(*(self._pad_stack([getattr(t, f) for t in tvs], jpad)
+                         for f in Traversal._fields))
+        pq = [(self._gidx_identity(j.p.number),
+               self._gidx_identity(j.p.back.number)) for j in jobs]
+        obs.inc("engine.dispatch_count")
+        with obs.device_span("fleet:batch_eval_scan",
+                             args={"jobs": len(jobs), "jpad": jpad}):
+            _, _, out = fn(clv, scaler, tv,
+                           self._pad_stack([jnp.int32(p) for p, _ in pq],
+                                           jpad),
+                           self._pad_stack([jnp.int32(q) for _, q in pq],
+                                           jpad),
+                           self._pad_stack(
+                               [jnp.asarray(j.z, eng.dtype) for j in jobs],
+                               jpad),
+                           eng.models, eng.block_part, eng.weights,
+                           eng.tips, eng.site_rates)
+        return np.asarray(out)
+
+    # -- weights-only batch (shared topology) --------------------------------
+
+    def eval_weights_batch(self, tree,
+                           per_job_weights: List[List[np.ndarray]]
+                           ) -> np.ndarray:
+        """Per-job per-partition lnL [J, M] of J weight replicates on
+        ONE topology: a single ordinary CLV pass (shared programs — the
+        schedule and jit caches hit), then one batched root reduction
+        per engine."""
+        from examl_tpu.fleet import bootstrap as _bs
+        J = len(per_job_weights)
+        assert J
+        jpad = self._pick_jpad(WEIGHTS_GROUP, J)
+        p = tree.centroid_branch()
+        # The one CLV pass: the NORMAL evaluation path (fast tier where
+        # eligible), so repeated replicate batches on the same topology
+        # are pure cache hits — engine.cache_hits / sched_cache.hit are
+        # the program-sharing acceptance evidence.  Consecutive weight
+        # batches on the same tree skip even the traversal: the live
+        # arenas are still this tree's CLVs as long as NO device
+        # program ran in between (every arena-mutating path — newview,
+        # newton, model grids, other fleet batches — bumps
+        # engine.dispatch_count, so the epoch is conservative).
+        if self._weights_pass != (id(tree),
+                                  obs.counter("engine.dispatch_count")):
+            self.inst.evaluate(tree, p, full=True)
+        else:
+            obs.inc("fleet.clv_pass_reuses")
+        M = len(self.inst.models)
+        per_part = np.full((J, M), np.nan)
+        obs.gauge("fleet.batch_occupancy", J / jpad)
+        for eng in self.engines:
+            w = [_bs.packed_weights(eng.bucket, pj) for pj in per_job_weights]
+            fn = self._weights_fn(eng, jpad)
+            buf, _aux = eng._state()
+            zv = jnp.asarray(z_slots(p.z, self.C), dtype=eng.dtype)
+            obs.inc("engine.dispatch_count")
+            with obs.device_span("fleet:weights_eval",
+                                 args={"jobs": J, "jpad": jpad}):
+                out = fn(self._pad_stack(
+                             [jnp.asarray(x, eng.dtype) for x in w], jpad),
+                         buf, eng.scaler,
+                         jnp.int32(eng._gidx(p.number)),
+                         jnp.int32(eng._gidx(p.back.number)),
+                         zv, eng.models, eng.block_part, eng.tips,
+                         eng.site_rates)
+            vals = np.asarray(out)
+            for li, gid in enumerate(eng.bucket.part_ids):
+                per_part[:, gid] = vals[:J, li]
+        self._weights_pass = (id(tree),
+                              obs.counter("engine.dispatch_count"))
+        return per_part

@@ -1,0 +1,359 @@
+"""The fleet job-queue driver: profile-grouped batched dispatch.
+
+Pending jobs group by their batch key — the fastpath segment profile
+(PR5: the jit key, shared across topologies of similar shape), the
+scan-tier [L, W] shape under PSR/force_scan, or the shared-topology
+weights group for bootstrap replicates — so compile cost, the launch
+floor, and the batched root reduction amortize fleet-wide: the first
+job of a group compiles the group's ONE program, every later batch of
+that group is a cache hit.
+
+Resilience rides the existing stack: the driver beats the search-loop
+heartbeat per batch (so `--supervise` stall detection and the
+`search.kill` chaos seam work unchanged), checkpoints the whole job
+table through CheckpointManager after every batch (state "FLEET" —
+numbered, fsynced, corrupt-tolerant, gang-two-phase under --launch),
+and a `-R` restart (or a supervisor resume) skips finished jobs — a
+kill loses at most each in-flight job's current cycle.
+
+Observability: `fleet.*` counters/gauges (queue depth, jobs done,
+batch occupancy, trees_per_sec) and ledger events `job.start` /
+`job.done` / `batch.dispatch` so a serving run is visible live
+(tools/top.py) and in the post-run report (tools/run_report.py).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from examl_tpu import obs
+from examl_tpu.fleet import bootstrap as _bootstrap
+from examl_tpu.fleet.batch import WEIGHTS_GROUP, batch_eligible
+from examl_tpu.fleet.jobs import JobSpec
+
+
+class FleetDriver:
+    def __init__(self, inst, start_tree=None, batch_cap: int = 16,
+                 cycles: int = 1, mgr=None, log=None,
+                 checkpoint_every: int = 1):
+        self.inst = inst
+        self.start_tree = start_tree          # bootstrap topology (+ ckpt
+        self.batch_cap = max(1, int(batch_cap))   # scaffold)
+        self.cycles = max(1, int(cycles))
+        self.mgr = mgr
+        self.log = log or (lambda *_: None)
+        self.checkpoint_every = max(1, int(checkpoint_every))
+        reason = batch_eligible(inst)
+        self.evaluator = inst.batch_evaluator()
+        if reason is not None:
+            self.log(f"fleet: batched tier unavailable ({reason}); "
+                     "jobs evaluate one at a time")
+        self.jobs: List[JobSpec] = []
+        self._trees: Dict[str, object] = {}       # job_id -> Tree
+        self._prepared: Dict[str, object] = {}    # job_id -> PreparedJob
+        self._weights: Dict[str, list] = {}       # job_id -> per-part w
+        self._keys: Dict[str, object] = {}        # job_id -> batch key
+        self._started: set = set()                # job.start emitted (this
+        self._batches_since_ckpt = 0              # process)
+
+    def _evict(self, job: JobSpec) -> None:
+        """Drop a finished job's host-side state: a long-running
+        `--serve` process must not keep every completed job's Tree,
+        FastStructure and weight arrays alive forever."""
+        for cache in (self._trees, self._prepared, self._weights,
+                      self._keys):
+            cache.pop(job.job_id, None)
+
+    # -- job-table persistence (rides CheckpointManager) --------------------
+
+    def extras(self) -> dict:
+        return {"fleet": {"jobs": [j.to_dict() for j in self.jobs],
+                          "cycles": self.cycles}}
+
+    def restore_jobs(self, extras: dict, jobs=None) -> int:
+        """Merge a restored job table into `jobs` (default: the whole
+        queue), matched by job_id: finished jobs stay finished,
+        in-flight jobs keep their completed cycles and their current
+        tree.  Returns the number of jobs restored as done.
+
+        The serve loop passes each poll's FRESH specs only, so the
+        snapshot applies to every job exactly once — at the moment it
+        joins the queue.  Re-applying it to the whole table would
+        regress jobs completed after the resume; never applying it to
+        late-arriving lines (a torn final line consumed a poll later)
+        would re-run a job the checkpoint knows is done."""
+        blob = (extras or {}).get("fleet") or {}
+        by_id = {d.get("job_id"): d for d in blob.get("jobs", [])}
+        done = 0
+        for job in (self.jobs if jobs is None else jobs):
+            d = by_id.get(job.job_id)
+            if d is None:
+                continue
+            rj = JobSpec.from_dict(d)
+            job.cycles_done = rj.cycles_done
+            job.lnl = rj.lnl
+            job.done = rj.done
+            job.failed = rj.failed
+            if rj.newick:
+                job.newick = rj.newick
+            done += int(job.done)
+        return done
+
+    # -- job materialization -------------------------------------------------
+
+    def _tree_for(self, job: JobSpec):
+        t = self._trees.get(job.job_id)
+        if t is not None:
+            return t
+        if job.kind == "bootstrap":
+            if self.start_tree is None:
+                raise ValueError("bootstrap jobs need a starting tree (-t)")
+            t = self.start_tree
+        elif job.newick:                       # eval job / resumed start job
+            t = self.inst.tree_from_newick(job.newick)
+        else:                                  # multi-start: derived seed
+            t = self.inst.random_tree(seed=job.seed)
+        self._trees[job.job_id] = t
+        return t
+
+    def _key_for(self, job: JobSpec):
+        if job.kind == "bootstrap":
+            self._tree_for(job)                # raises without a -t tree
+            return WEIGHTS_GROUP
+        if self.evaluator is None:
+            return ("seq", job.job_id)         # no grouping: one per batch
+        prep = self.evaluator.prepare(self._tree_for(job),
+                                      self._prepared.get(job.job_id))
+        self._prepared[job.job_id] = prep
+        return prep.key
+
+    def _weights_for(self, job: JobSpec) -> list:
+        w = self._weights.get(job.job_id)
+        if w is None:
+            w = _bootstrap.bootstrap_weights(self.inst.alignment, job.seed)
+            self._weights[job.job_id] = w
+        return w
+
+    # -- the queue loop ------------------------------------------------------
+
+    def run(self, jobs: List[JobSpec],
+            resume_extras: Optional[dict] = None) -> List[JobSpec]:
+        self.jobs = list(jobs)
+        restored = 0
+        if resume_extras:
+            restored = self.restore_jobs(resume_extras)
+            self.log(f"fleet: resumed job table — {restored} of "
+                     f"{len(self.jobs)} jobs already done")
+        obs.gauge("fleet.jobs_total", len(self.jobs))
+        self.drain()
+        return self.jobs
+
+    def pending(self) -> List[JobSpec]:
+        return [j for j in self.jobs if not j.done]
+
+    def drain(self) -> None:
+        """Run batches until no job is pending."""
+        from examl_tpu.resilience import heartbeat
+        while True:
+            pending = self.pending()
+            obs.gauge("fleet.queue_depth", len(pending))
+            # "done" means SUCCEEDED: failed jobs leave the queue but
+            # must not read as successes on the operator's live view.
+            obs.gauge("fleet.jobs_done",
+                      sum(1 for j in self.jobs
+                          if j.done and not j.failed))
+            if not pending:
+                break
+            # Group by batch key; dispatch the largest group first so
+            # occupancy stays high while the queue is deep.  A job that
+            # cannot even materialize (malformed eval newick, a
+            # bootstrap job with no -t tree in serve mode) fails ALONE
+            # — one poisoned job must not kill the serving process.
+            groups: Dict[object, List[JobSpec]] = {}
+            for job in pending:
+                # The batch key is a function of the job's topology,
+                # which no current work kind changes — computed once
+                # per job, so regrouping a deep queue costs O(pending)
+                # dict lookups, not O(pending) schedule builds.
+                key = self._keys.get(job.job_id)
+                if key is None:
+                    try:
+                        key = self._key_for(job)
+                    except Exception as exc:   # noqa: BLE001
+                        job.done = job.failed = True
+                        self._evict(job)
+                        obs.inc("fleet.jobs_failed")
+                        obs.ledger_event("job.failed", job=job.job_id,
+                                         error=str(exc)[:200])
+                        self.log(f"fleet: job {job.job_id} failed to "
+                                 f"materialize ({exc})")
+                        continue
+                    self._keys[job.job_id] = key
+                groups.setdefault(key, []).append(job)
+            if not groups:
+                continue                       # everything failed: re-check
+            batch = max(groups.values(), key=len)[:self.batch_cap]
+            # The heartbeat IS the fleet's iteration clock: supervise
+            # stall detection, search.kill chaos addressing, and the
+            # periodic metrics flush all tick here.
+            heartbeat.beat("FLEET")
+            self._dispatch(batch)
+            self._batches_since_ckpt += 1
+            if self.mgr is not None and \
+                    self._batches_since_ckpt >= self.checkpoint_every:
+                self._batches_since_ckpt = 0
+                self._checkpoint()
+                # Preemption cadence: the job table just persisted, so
+                # a pending SIGTERM/SIGINT exits resumable HERE (exit
+                # 75; a --supervise parent resumes without consuming a
+                # retry) — at most the next batch's cycle is redone.
+                from examl_tpu.resilience import preempt
+                preempt.check_after_checkpoint(log=self.log)
+        obs.gauge("fleet.queue_depth", 0)
+        obs.gauge("fleet.jobs_done",
+                  sum(1 for j in self.jobs if j.done and not j.failed))
+        if self.mgr is not None and self._batches_since_ckpt:
+            self._batches_since_ckpt = 0
+            self._checkpoint()
+
+    def _checkpoint(self) -> None:
+        tree = self.start_tree
+        if tree is None:
+            live = next((self._trees[j.job_id] for j in self.jobs
+                         if j.job_id in self._trees), None)
+            tree = live if live is not None \
+                else self.inst.random_tree(seed=0)
+        self.mgr.write("FLEET", self.extras(), self.inst, tree)
+
+    # -- batch dispatch ------------------------------------------------------
+
+    def _dispatch(self, batch: List[JobSpec]) -> None:
+        for job in batch:
+            if job.job_id not in self._started:
+                self._started.add(job.job_id)
+                obs.ledger_event("job.start", job=job.job_id,
+                                 job_kind=job.kind, index=job.index,
+                                 seed=job.seed, cycle=job.cycles_done)
+        obs.ledger_event("batch.dispatch", jobs=len(batch),
+                         job_kind=batch[0].kind,
+                         ids=",".join(j.job_id for j in batch[:8]))
+        compiles0 = obs.counter("engine.compile_count")
+        t0 = time.perf_counter()
+        try:
+            if batch[0].kind == "bootstrap":
+                per_part = self._dispatch_bootstrap(batch)
+            else:
+                per_part = self._dispatch_trees(batch)
+        except FloatingPointError as exc:
+            # Poisoned lnL past the engine's scan-tier retry: fail the
+            # batch's jobs, keep serving the rest of the queue.
+            for job in batch:
+                job.done = job.failed = True
+                self._evict(job)
+                obs.inc("fleet.jobs_failed")
+                obs.ledger_event("job.failed", job=job.job_id,
+                                 error=str(exc)[:200])
+            return
+        dt = time.perf_counter() - t0
+        obs.inc("fleet.batches")
+        obs.inc("fleet.trees_evaluated", len(batch))
+        obs.inc("fleet.eval_seconds", dt)
+        # The throughput gauge only takes WARM batches: a batch whose
+        # wall contained a first-call compile would publish a
+        # near-zero trees/sec wrongly read as serving throughput (the
+        # same discipline as the engine's bandwidth windows).
+        if dt > 0 and obs.counter("engine.compile_count") == compiles0:
+            obs.gauge("fleet.trees_per_sec", round(len(batch) / dt, 3))
+        for i, job in enumerate(batch):
+            lnl = float(per_part[i].sum())
+            if not np.isfinite(lnl):
+                job.done = job.failed = True
+                self._evict(job)
+                obs.inc("fleet.jobs_failed")
+                obs.ledger_event("job.failed", job=job.job_id,
+                                 error="non-finite lnL")
+                continue
+            job.lnl = lnl
+            job.cycles_done += 1
+            obs.inc("fleet.cycles")
+            if job.kind != "bootstrap":
+                tree = self._trees.get(job.job_id)
+                if tree is not None:
+                    job.newick = tree.to_newick(
+                        self.inst.alignment.taxon_names)
+            if job.cycles_done >= job.cycles:
+                job.done = True
+                self._evict(job)
+                obs.inc("fleet.jobs_done_total")
+                obs.ledger_event("job.done", job=job.job_id,
+                                 job_kind=job.kind, lnl=round(lnl, 6),
+                                 cycles=job.cycles_done)
+
+    def _dispatch_bootstrap(self, batch: List[JobSpec]) -> np.ndarray:
+        tree = self._tree_for(batch[0])
+        weights = [self._weights_for(j) for j in batch]
+        if self.evaluator is not None:
+            return self.evaluator.eval_weights_batch(tree, weights)
+        return self._sequential_weights(tree, weights)
+
+    def _dispatch_trees(self, batch: List[JobSpec]) -> np.ndarray:
+        # Later cycles smooth branch lengths before re-evaluating (the
+        # multi-start refinement loop); cycle 0 scores the tree as is.
+        later = [j for j in batch if j.cycles_done > 0]
+        if later:
+            from examl_tpu.constants import SMOOTHINGS
+            from examl_tpu.optimize.branch import smooth_tree
+            for job in later:
+                tree = self._tree_for(job)
+                # Smoothing's per-branch Newton steps gather CLVs
+                # through the ENGINE's live arena/row map, which the
+                # batched cycles never touched — a real full traversal
+                # on the engine orients it to THIS tree first, exactly
+                # the precondition tree_evaluate's callers establish.
+                self.inst.evaluate(tree, full=True)
+                smooth_tree(self.inst, tree, SMOOTHINGS)
+            if self.evaluator is not None:
+                # Re-prepare AFTER smoothing: the PreparedJobs captured
+                # at grouping time hold pre-smoothing z arrays; the
+                # topology is unchanged, so the cached structure (and
+                # the batch group key) survive and only z refreshes.
+                for job in later:
+                    self._prepared[job.job_id] = self.evaluator.prepare(
+                        self._tree_for(job),
+                        self._prepared.get(job.job_id))
+        if self.evaluator is not None:
+            preps = [self._prepared[j.job_id] for j in batch]
+            return self.evaluator.eval_batch(preps)
+        out = np.stack([self._sequential_eval(self._tree_for(j))
+                        for j in batch])
+        return out
+
+    # -- sequential fallback (SEV / sharded instances) -----------------------
+
+    def _sequential_eval(self, tree) -> np.ndarray:
+        self.inst.evaluate(tree, full=True)
+        return np.array(self.inst.per_partition_lnl, copy=True)
+
+    def _sequential_weights(self, tree, weights: List[list]) -> np.ndarray:
+        import jax.numpy as jnp
+        self.inst.evaluate(tree, full=True)
+        out = []
+        p = tree.centroid_branch()
+        for per_part in weights:
+            row = np.full(len(self.inst.models), np.nan)
+            for eng in self.inst.engines.values():
+                saved = eng.weights
+                eng.weights = jnp.asarray(
+                    _bootstrap.packed_weights(eng.bucket, per_part),
+                    eng.dtype)
+                try:
+                    vals = eng.evaluate(p.number, p.back.number, p.z)
+                finally:
+                    eng.weights = saved
+                for li, gid in enumerate(eng.bucket.part_ids):
+                    row[gid] = vals[li]
+            out.append(row)
+        return np.stack(out)

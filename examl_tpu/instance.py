@@ -252,6 +252,20 @@ class PhyloInstance:
                 continue
             eng.run_traversal(entries, full=full)
 
+    def batch_evaluator(self):
+        """The fleet tier's batched many-tree evaluator over this
+        instance (examl_tpu/fleet/batch.py), or None when the instance
+        is ineligible (-S SEV pools, sharded arenas) — one evaluator
+        per instance so its compiled-pad bookkeeping and prepared-job
+        caches persist across fleet batches."""
+        ev = getattr(self, "_batch_evaluator", None)
+        if ev is None:
+            from examl_tpu.fleet.batch import BatchEvaluator, batch_eligible
+            if batch_eligible(self) is not None:
+                return None
+            ev = self._batch_evaluator = BatchEvaluator(self)
+        return ev
+
     def invalidate_schedules(self) -> None:
         """Drop every engine's cached schedule structures.  Called from
         the search's topology-commit seams (SPR regraft, best-tree

@@ -205,6 +205,9 @@ def test_cli_bank_moves_compiles_off_the_search_path(tmp_path,
     assert "banking" in info and "bank manifest ->" in info
 
 
+@pytest.mark.slow          # ~130 s: the heaviest tier-1 case (PR8 runtime
+                           # audit) — the hang->degrade contract also has
+                           # non-slow unit coverage in this file
 def test_cli_bank_hanging_compile_degrades_to_scan_tier(tmp_path,
                                                         monkeypatch):
     """The satellite acceptance test: a WEDGED first compile of a

@@ -625,7 +625,19 @@ def test_e2e_rank_death_gang_killed_coordinated_resume(gang_run,
     c = snap["counters"]
     assert c["resilience.gang.rank_deaths"] == 1
     assert c["resilience.restarts"] >= 1
-    assert c["checkpoint.gang_publishes"] >= 1     # two-phase commits
+    # Two-phase commit evidence: `checkpoint.gang_publishes` is counted
+    # in the process of whichever rank stages LAST and wins the publish
+    # rename — when that is rank 1 (a scheduling race), the counter
+    # lives in rank 1's registry, which the rank-0-only --metrics
+    # snapshot never persists.  The rank-COMPLETE record is the merged
+    # ledger: every rank's `checkpoint.publish` events survive there.
+    pubs = c.get("checkpoint.gang_publishes", 0)
+    if not pubs:
+        from examl_tpu.obs import ledger as _ledger_mod
+        merged = os.path.join(str(gang_run["root"]), "ledger.merged.jsonl")
+        pubs = sum(1 for e in _ledger_mod.read_events(merged)
+                   if e["kind"] == "checkpoint.publish")
+    assert pubs >= 1                               # two-phase commits
     att = snap["resilience"]["attempts"]
     assert att[0]["cause"] == "oom-kill" and att[0]["rank"] == 1
     assert att[0]["rank_exits"]["r0"] == "gang-killed"
@@ -637,6 +649,9 @@ def test_e2e_rank_death_gang_killed_coordinated_resume(gang_run,
         == pytest.approx(gang_run["lnl"], abs=LNL_TOL)
 
 
+@pytest.mark.slow          # ~40 s: tier-1 keeps the rank-death coordinated
+                           # resume e2e; elastic shrink stays covered by
+                           # the stub-children unit tests (PR8 audit)
 def test_e2e_elastic_shrink_to_one_rank(gang_run, monkeypatch):
     """Elastic resume: a gang that loses rank 1 on every attempt
     degrades to 1 rank after ELASTIC_CONSECUTIVE_DEATHS and FINISHES,

@@ -477,6 +477,9 @@ def test_e2e_sigkill_mid_search_resumes_to_same_lnl(chaos_run,
         == pytest.approx(chaos_run["lnl"], abs=LNL_TOL)
 
 
+@pytest.mark.slow          # ~60 s REAL stall wait (chaos timing pitfall:
+                           # needs a genuine hang) — tier-1 keeps the
+                           # SIGKILL and SIGTERM chaos e2e (PR8 audit)
 def test_e2e_heartbeat_stall_killed_and_degraded_retry(chaos_run,
                                                        monkeypatch):
     """A dispatch/collective wedge — the main thread blocks INSIDE a

@@ -135,6 +135,45 @@ def render_timers(out, snap: dict) -> None:
             f"{_fmt_s(t.get('p99_s')):>10s} {_fmt_s(t.get('max_s')):>10s}")
 
 
+def render_fleet(out, snap: dict, events: list) -> None:
+    """Fleet serving evidence: the `fleet.*` counters/gauges plus the
+    job timeline summary (job.start / job.done / batch.dispatch ledger
+    events) for `-b` / `-N` / `--serve` runs."""
+    c = snap.get("counters") or {}
+    g = snap.get("gauges") or {}
+    jc = {"job.start": 0, "job.done": 0, "job.failed": 0,
+          "batch.dispatch": 0}
+    for ev in events:
+        k = ev.get("kind")
+        if k in jc:
+            jc[k] += 1
+    if not (any(k.startswith("fleet.") for k in c)
+            or any(k.startswith("fleet.") for k in g)
+            or any(jc.values())):
+        return
+    out("")
+    out("Fleet (many-tree batched serving):")
+    total = int(g.get("fleet.jobs_total", 0))
+    done = int(g.get("fleet.jobs_done", 0))
+    out(f"  jobs done                  {done}/{total}"
+        + (f"  ({int(c['fleet.jobs_failed'])} failed)"
+           if c.get("fleet.jobs_failed") else ""))
+    if c.get("fleet.batches"):
+        trees = c.get("fleet.trees_evaluated", 0)
+        secs = c.get("fleet.eval_seconds", 0.0)
+        out(f"  batches                    {int(c['fleet.batches'])}"
+            f"  ({trees:.0f} tree evals in {secs:.2f}s eval wall)")
+    if g.get("fleet.trees_per_sec") is not None:
+        out(f"  trees_per_sec (last batch) "
+            f"{g['fleet.trees_per_sec']:.3f}")
+    if g.get("fleet.batch_occupancy") is not None:
+        out(f"  batch occupancy            "
+            f"{g['fleet.batch_occupancy']:.2f}")
+    if any(jc.values()):
+        out("  job timeline events        "
+            + "  ".join(f"{k}={v}" for k, v in sorted(jc.items()) if v))
+
+
 def render_counters(out, snap: dict) -> None:
     c = snap.get("counters") or {}
     picks = [
@@ -229,7 +268,16 @@ def render(metrics: dict, events: list, bench: dict,
     rows = tier_rows_from_metrics(metrics)
     if rows:
         render_roofline(out, rows, "in-engine windowed gauges")
-    if bench:
+    if bench and bench.get("bench") == "fleet":
+        out("")
+        out("Fleet bench row (tools/fleet_smoke.py):")
+        out(f"  trees_per_sec {bench.get('trees_per_sec')}  "
+            f"(single-tree {bench.get('single_trees_per_sec')}/s; "
+            f"speedup {bench.get('speedup_vs_single')}x vs target "
+            f"{bench.get('target_speedup')}x = 0.7*N, "
+            + ("MET" if bench.get("meets_target") else "not met")
+            + f"; occupancy {bench.get('batch_occupancy')})")
+    elif bench:
         if rows:
             out("")
         render_roofline(out, tier_rows_from_bench(bench), "BENCH rows")
@@ -243,6 +291,7 @@ def render(metrics: dict, events: list, bench: dict,
     if not rows and not bench:
         render_roofline(out, [], "no artifact")
     render_timers(out, metrics)
+    render_fleet(out, metrics, events)
     render_counters(out, metrics)
     # Bench artifacts embed the workers' merged registry under
     # "metrics"; surface its timers too when the standalone snapshot

@@ -122,6 +122,16 @@ def render_frame(out, workdir: str, beats: list, metrics_path,
                 for k, v in sorted(gauges.items())
                 if k.startswith("engine.achieved_gbps.")]
         tag = " (mid-run flush)" if snap.get("partial") else ""
+        # Fleet serving view: queue depth, done/total, throughput and
+        # the last batch's occupancy — the live row for `-b`/`-N`/
+        # `--serve` runs (gauges flush mid-run via the heartbeat tick).
+        if "fleet.jobs_total" in gauges:
+            out(f"  fleet{tag}: "
+                f"queue={int(gauges.get('fleet.queue_depth', 0))}  "
+                f"done={int(gauges.get('fleet.jobs_done', 0))}"
+                f"/{int(gauges.get('fleet.jobs_total', 0))}  "
+                f"trees/s={gauges.get('fleet.trees_per_sec', 0.0):.3g}  "
+                f"occupancy={gauges.get('fleet.batch_occupancy', 0.0):.2f}")
         if rows:
             out(f"  roofline{tag}: "
                 + "  ".join(f"{t}={v:.3g}GB/s" for t, v in rows))
