@@ -89,6 +89,26 @@ def build_argparser() -> argparse.ArgumentParser:
                     help="seconds between jobs-file polls under --serve "
                          "(0 = drain current contents and exit; "
                          "default 1)")
+    ap.add_argument("--serve-max-pending", dest="serve_max_pending",
+                    type=int, default=10000,
+                    help="admission control: stop consuming new jobs-"
+                         "file lines while this many jobs are pending "
+                         "(the queue drains, then ingestion resumes; "
+                         "default 10000)")
+    ap.add_argument("--fleet-job-attempts", dest="fleet_job_attempts",
+                    type=int, default=2,
+                    help="per-job attempt cap: a job whose dispatch "
+                         "fails this many times (non-finite lnL, "
+                         "dispatch error, blown deadline) is "
+                         "quarantined to ExaML_fleetFailed.<run> "
+                         "instead of retried (default 2)")
+    ap.add_argument("--fleet-job-deadline", dest="fleet_job_deadline",
+                    type=float, default=0.0,
+                    help="wall-clock seconds one batched fleet dispatch "
+                         "may take before a --supervise parent kills "
+                         "the attempt as JOB-stuck (no run-level retry "
+                         "consumed; repeat offenders quarantine).  "
+                         "0 disables the per-job deadline (default)")
     ap.add_argument("--fleet-batch", dest="fleet_batch", type=int,
                     default=16,
                     help="max jobs per batched fleet dispatch "
@@ -520,11 +540,34 @@ def _write_per_gene_trees(args, inst, tree, files: RunFiles) -> None:
 def run_fleet(args, inst, files: RunFiles) -> int:
     """Fleet modes (-b K / -N K / --serve): the profile-grouped batched
     job queue (examl_tpu/fleet/driver.py) with per-job checkpoints and
-    `-R` resume through the normal CheckpointManager stack."""
+    `-R` resume through the normal CheckpointManager stack, job-level
+    fault domains (retry/quarantine, fleet/quarantine.py) and a
+    durable per-job results journal reconciled at resume."""
     from examl_tpu.fleet import jobs as jobs_mod
+    from examl_tpu.fleet import quarantine
     from examl_tpu.fleet.driver import FleetDriver
 
     mgr = _checkpoint_manager(args, keep_last=2)
+    journal = quarantine.ResultsJournal(os.path.join(
+        args.workdir, f"ExaML_fleetJournal.{args.run_id}"))
+    deadletters = quarantine.DeadLetters(os.path.join(
+        args.workdir, f"ExaML_fleetFailed.{args.run_id}"))
+    if not args.restart:
+        # A FRESH run (no -R) reusing a run id must not inherit an
+        # abandoned incarnation's journal/dead letters: `-R` later
+        # would reconcile the OLD records as done and silently skip
+        # jobs whose inputs changed.  Checkpoints rotate via keep_last;
+        # these files are removed so they exist only once this
+        # incarnation appends (the supervisor keys its automatic -R on
+        # that existence).
+        for stale in (journal.path, deadletters.path):
+            try:
+                os.unlink(stale)
+            except OSError:
+                pass
+    policy = quarantine.JobFaultPolicy(
+        max_attempts=args.fleet_job_attempts,
+        deadline_s=args.fleet_job_deadline)
     start_tree = None
     if args.tree_file:
         start_tree = inst.tree_from_newick(_read_trees(args.tree_file)[0])
@@ -535,20 +578,44 @@ def run_fleet(args, inst, files: RunFiles) -> int:
     if args.restart:
         scaffold = (start_tree if start_tree is not None
                     else inst.random_tree(seed=args.seed))
+        # GC-ordering contract: the journal is read and reconciled
+        # HERE, strictly before the driver's first checkpoint write —
+        # the only place keep_last pruning runs — and the journal /
+        # dead-letter files never match the checkpoint glob, so a
+        # concurrent-looking resume can never have its evidence
+        # collected out from under it (tests/test_quarantine.py pins
+        # both properties).
         res = mgr.restore(inst, scaffold)
-        if res is None:
-            files.info("no checkpoint found; cannot restart")
-            return 1
-        if res["state"] != "FLEET":
+        journal_recs = journal.read()
+        if res is not None and res["state"] != "FLEET":
             files.info(f"checkpoint state {res['state']} is not a fleet "
                        "checkpoint")
             return 1
-        resume = res["extras"]
-        files.info("restart from fleet checkpoint")
+        if res is None and not journal_recs:
+            if os.path.exists(journal.path):
+                # A journal that exists but yields no intact record (a
+                # kill inside the very first append): nothing finished,
+                # so a fresh start IS the correct resume.
+                files.info("no checkpoint and no intact journal "
+                           "record; starting the fleet from scratch")
+            else:
+                files.info("no checkpoint found; cannot restart")
+                return 1
+        # Journal ∪ checkpoint: a SIGKILL between a batch and its
+        # checkpoint must not replay the batch's finished jobs — the
+        # journal (written per job, fsync'd) is the fresher record.
+        resume = quarantine.reconcile_extras(
+            res["extras"] if res is not None else {}, journal_recs)
+        files.info(
+            "restart from fleet "
+            + ("checkpoint" if res is not None else "results journal")
+            + (f" (+ {len(journal_recs)} journal record(s) reconciled)"
+               if journal_recs and res is not None else ""))
     driver = FleetDriver(inst, start_tree=start_tree,
                          batch_cap=args.fleet_batch,
                          cycles=args.fleet_cycles, mgr=mgr,
-                         log=files.info)
+                         log=files.info, policy=policy,
+                         journal=journal, deadletters=deadletters)
     if args.serve:
         jobs = _serve_loop(args, driver, files, resume)
     else:
@@ -570,15 +637,36 @@ def run_fleet(args, inst, files: RunFiles) -> int:
     return _write_fleet_results(args, inst, files, jobs)
 
 
+def _reject_job(files: RunFiles, job_id, reason: str) -> None:
+    """Admission rejection: ledger event + counter + operator line —
+    the driver never sees the spec, so a rejected job can neither
+    crash the loop nor occupy the queue."""
+    from examl_tpu import obs
+    obs.inc("fleet.rejected")
+    obs.ledger_event("job.rejected", job=job_id, reason=reason[:200])
+    files.info(f"fleet: job "
+               + (f"{job_id!r} " if job_id else "")
+               + f"REJECTED at admission ({reason})")
+
+
 def _serve_loop(args, driver, files: RunFiles, resume):
     """Drain + poll the jobs file until a stop sentinel (or, with
     --serve-poll 0, until the current contents are drained).  Jobs are
     addressed by line index, so appends never re-seed earlier jobs and
-    a resume re-parses the whole file and skips finished ones."""
+    a resume re-parses the whole file and skips finished ones.
+
+    ADMISSION CONTROL: specs that parse but cannot run (bad tree
+    strings, taxa mismatch vs the alignment, duplicate ids, malformed
+    lines) are rejected with a `job.rejected` event instead of joining
+    the queue, and ingestion pauses — `--serve-max-pending` — while the
+    pending queue is full, so a runaway producer bounds memory instead
+    of growing the job table without limit."""
     from examl_tpu import obs
+    from examl_tpu.fleet import quarantine
     from examl_tpu.fleet.jobs import parse_jobs_lines
     from examl_tpu.resilience import heartbeat, preempt
 
+    max_pending = max(1, int(getattr(args, "serve_max_pending", 10000)))
     processed = 0
     stop = False
     torn_prev = None
@@ -603,40 +691,86 @@ def _serve_loop(args, driver, files: RunFiles, resume):
                 lines = lines[:-1]
         else:
             torn_prev = None
-        if len(lines) > processed:
-            specs, stop_seen = parse_jobs_lines(
-                lines[processed:], args.seed,
-                default_cycles=args.fleet_cycles, start_index=processed,
-                on_error=lambda msg: files.info(
-                    f"fleet: skipping malformed jobs line ({msg})"))
-            processed = len(lines)
-            stop = stop or stop_seen
-            # Duplicate ids would alias the driver's per-job caches and
-            # collapse table/resume records: first definition wins.
-            existing = {j.job_id for j in driver.jobs}
-            fresh = []
-            for s in specs:
-                if s.job_id in existing:
-                    files.info(f"fleet: skipping duplicate job id "
-                               f"{s.job_id!r}")
-                    continue
-                existing.add(s.job_id)
-                fresh.append(s)
-            specs = fresh
-            if specs:
-                driver.jobs.extend(specs)
-                if resume:
-                    # Apply the checkpoint snapshot to the FRESH specs
-                    # only — each job sees it exactly once, as it joins
-                    # the queue.  A whole-table re-application would
-                    # regress jobs completed after the resume; a
-                    # one-shot application would miss a finished job
-                    # whose torn final line is consumed a poll later
-                    # (re-running it and double-counting job.done).
-                    driver.restore_jobs(resume, specs)
-                files.info(f"fleet: {len(specs)} new jobs from "
-                           f"{args.serve} (queue {len(driver.jobs)})")
-            obs.gauge("fleet.jobs_total", len(driver.jobs))
+        # Bounded pending queue (--serve-max-pending): consume at most
+        # `budget` new jobs per poll; the rest of the file (line
+        # indexing keeps the derived seeds stable) re-parses once the
+        # queue drains.  The budget subtracts live pending jobs
+        # defensively — today drain() empties the queue before each
+        # poll, so the bound is enforced by the per-poll cut alone.
+        budget = max_pending - len(driver.pending())
+        if len(lines) > processed and budget > 0:
+            tail = lines[processed:]
+            if any(ln.strip() and not ln.strip().startswith("#")
+                   for ln in tail):
+                errors = []
+                specs, stop_seen = parse_jobs_lines(
+                    tail, args.seed,
+                    default_cycles=args.fleet_cycles,
+                    start_index=processed, on_error=errors.append)
+                if len(specs) > budget:
+                    # Cut at the first unadmitted spec's line and
+                    # RE-PARSE only the consumed prefix: its errors are
+                    # reported exactly once, and a stop sentinel before
+                    # the cut is honored (forcing stop_seen=False here
+                    # would consume and permanently lose it), while
+                    # everything past the cut re-parses next poll.
+                    cut = specs[budget].index
+                    errors = []
+                    specs, stop_seen = parse_jobs_lines(
+                        tail[:cut - processed], args.seed,
+                        default_cycles=args.fleet_cycles,
+                        start_index=processed, on_error=errors.append)
+                    processed = cut
+                else:
+                    processed = len(lines)
+                for msg in errors:
+                    _reject_job(files, None, f"malformed line: {msg}")
+                stop = stop or stop_seen
+                # Duplicate ids — within a poll or ACROSS polls — would
+                # alias the driver's per-job caches and collapse
+                # table/resume records: first definition wins, later
+                # ones are rejected (visibly, not silently dropped).
+                existing = {j.job_id for j in driver.jobs}
+                fresh = []
+                for s in specs:
+                    if s.job_id in existing:
+                        _reject_job(files, s.job_id, "duplicate job id")
+                        continue
+                    # The admission parse seeds the driver's tree cache
+                    # (one parse per eval job) — but NOT on a resumed
+                    # loop: restore_jobs below may replace job.newick
+                    # with the checkpointed current tree, and a
+                    # pre-seeded cache would serve the stale original
+                    # (and pin trees for already-done jobs forever).
+                    reason = quarantine.admission_error(
+                        s, driver.inst, driver.start_tree,
+                        tree_cache=None if resume else driver._trees)
+                    if reason is not None:
+                        _reject_job(files, s.job_id, reason)
+                        continue
+                    existing.add(s.job_id)
+                    fresh.append(s)
+                specs = fresh
+                if specs:
+                    driver.jobs.extend(specs)
+                    if resume:
+                        # Apply the checkpoint snapshot to the FRESH
+                        # specs only — each job sees it exactly once,
+                        # as it joins the queue.  A whole-table
+                        # re-application would regress jobs completed
+                        # after the resume; a one-shot application
+                        # would miss a finished job whose torn final
+                        # line is consumed a poll later (re-running it
+                        # and double-counting job.done).
+                        driver.restore_jobs(resume, specs)
+                    driver.apply_hang_attempts(specs)
+                    files.info(f"fleet: {len(specs)} new jobs from "
+                               f"{args.serve} (queue {len(driver.jobs)})")
+                obs.gauge("fleet.jobs_total", len(driver.jobs))
+            else:
+                # Whitespace/comment-only append: a no-op, not a parse
+                # attempt (and not a log line per poll).
+                processed = len(lines)
         if driver.pending():
             driver.drain()
             continue
@@ -653,11 +787,18 @@ def _serve_loop(args, driver, files: RunFiles, resume):
 
 def _write_fleet_results(args, inst, files: RunFiles, jobs) -> int:
     """Per-job results table + result trees (rank-0 gated like every
-    other output)."""
+    other output).  Failed rows carry their failure cause and attempt
+    count — `fleet.jobs_failed` equals the quarantine count, and each
+    quarantined job's full record is in ExaML_fleetFailed.<run>."""
     ok = [j for j in jobs if j.done and not j.failed]
     failed = [j for j in jobs if j.failed]
     files.info(f"fleet: {len(ok)} jobs done, {len(failed)} failed, "
                f"{len(jobs) - len(ok) - len(failed)} pending")
+    if failed:
+        files.info(f"fleet: {len(failed)} quarantined job(s) with cause/"
+                   "attempts/last-error in "
+                   + os.path.join(args.workdir,
+                                  f"ExaML_fleetFailed.{args.run_id}"))
     if ok:
         best = max(ok, key=lambda j: j.lnl)
         files.info(f"fleet: best job {best.job_id} ({best.kind}) "
@@ -666,13 +807,15 @@ def _write_fleet_results(args, inst, files: RunFiles, jobs) -> int:
     if files.primary:
         table = os.path.join(args.workdir, f"ExaML_fleet.{args.run_id}")
         with open(table, "w") as f:
-            f.write("# job_id kind index seed cycles lnl status\n")
+            f.write("# job_id kind index seed cycles lnl status "
+                    "cause attempts\n")
             for j in jobs:
                 lnl = f"{j.lnl:.6f}" if j.lnl is not None else "nan"
                 status = ("failed" if j.failed
                           else "done" if j.done else "pending")
                 f.write(f"{j.job_id} {j.kind} {j.index} {j.seed} "
-                        f"{j.cycles_done}/{j.cycles} {lnl} {status}\n")
+                        f"{j.cycles_done}/{j.cycles} {lnl} {status} "
+                        f"{j.cause or '-'} {j.attempts}\n")
         files.info(f"fleet results -> {table}")
         trees = [j for j in ok if j.newick]
         if trees:
@@ -847,6 +990,12 @@ def main(argv=None) -> int:
         if args.bootstrap and not args.tree_file:
             ap.error("-b bootstrap replicates resample weights on a "
                      "fixed topology: a starting tree (-t) is required")
+        if args.fleet_job_attempts < 1:
+            ap.error("--fleet-job-attempts must be at least 1")
+        if args.fleet_job_deadline < 0:
+            ap.error("--fleet-job-deadline must be >= 0")
+        if args.serve_max_pending < 1:
+            ap.error("--serve-max-pending must be at least 1")
         if args.nprocs is not None or args.coordinator is not None:
             ap.error("fleet modes are single-process (the batched tier "
                      "stacks per-job arenas on one device set); run "

@@ -15,8 +15,13 @@ accelerator.  Pieces:
                 (same-profile topologies) or the scan-tier traversal
                 (PSR / force_scan), plus the weights-only batched root
                 reduction for shared-topology bootstrap replicates;
-* `jobs`      — job specs and the JSONL jobs-file format;
+* `jobs`      — job specs and the JSONL jobs-file format (admission
+                schema hardening included);
 * `driver`    — the profile-grouped work queue behind `-b K`, `-N K`
                 and `--serve`, with per-job checkpoints, heartbeat
-                beats and `fleet.*` observability.
+                beats and `fleet.*` observability;
+* `quarantine`— job-level fault domains: poison-job bisection, the
+                per-job retry/deadline ladder, dead letters, the
+                fsync'd results journal with journal ∪ checkpoint
+                resume reconciliation, and `--serve` admission checks.
 """

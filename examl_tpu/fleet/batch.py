@@ -217,9 +217,19 @@ class BatchEvaluator:
             return num - 1
         return self.ntips + (num - self.ntips - 1)
 
-    def eval_batch(self, jobs: List[PreparedJob]) -> np.ndarray:
+    def eval_batch(self, jobs: List[PreparedJob],
+                   record_occupancy: bool = True) -> np.ndarray:
         """Per-job per-partition lnL [J, M] for one same-key batch, in
-        ONE device dispatch per engine."""
+        ONE device dispatch per engine.
+
+        Rows are per-job INDEPENDENT (vmap over the tree axis), so a
+        poison job surfaces as exactly its own non-finite row — the
+        attribution the driver's job-level quarantine ladder keys on —
+        and a bisection sub-batch reuses the smallest already-compiled
+        pow2 program (`_pick_jpad`) instead of minting compiles.
+        Bisection probes pass `record_occupancy=False`: the operator
+        gauge must reflect the scheduled batches' real/padded ratio,
+        not isolation sub-dispatches."""
         assert jobs, "empty batch"
         assert len({j.key for j in jobs}) == 1, \
             "batch mixes job groups (driver bug)"
@@ -227,7 +237,8 @@ class BatchEvaluator:
         jpad = self._pick_jpad(jobs[0].key, J)
         M = len(self.inst.models)
         per_part = np.full((J, M), np.nan)
-        obs.gauge("fleet.batch_occupancy", J / jpad)
+        if record_occupancy:
+            obs.gauge("fleet.batch_occupancy", J / jpad)
         for eng in self.engines:
             vals = (self._eval_fast(eng, jobs, jpad) if self.fast
                     else self._eval_scan(eng, jobs, jpad))
@@ -304,8 +315,8 @@ class BatchEvaluator:
     # -- weights-only batch (shared topology) --------------------------------
 
     def eval_weights_batch(self, tree,
-                           per_job_weights: List[List[np.ndarray]]
-                           ) -> np.ndarray:
+                           per_job_weights: List[List[np.ndarray]],
+                           record_occupancy: bool = True) -> np.ndarray:
         """Per-job per-partition lnL [J, M] of J weight replicates on
         ONE topology: a single ordinary CLV pass (shared programs — the
         schedule and jit caches hit), then one batched root reduction
@@ -331,7 +342,8 @@ class BatchEvaluator:
             obs.inc("fleet.clv_pass_reuses")
         M = len(self.inst.models)
         per_part = np.full((J, M), np.nan)
-        obs.gauge("fleet.batch_occupancy", J / jpad)
+        if record_occupancy:
+            obs.gauge("fleet.batch_occupancy", J / jpad)
         for eng in self.engines:
             w = [_bs.packed_weights(eng.bucket, pj) for pj in per_job_weights]
             fn = self._weights_fn(eng, jpad)

@@ -20,10 +20,21 @@ Observability: `fleet.*` counters/gauges (queue depth, jobs done,
 batch occupancy, trees_per_sec) and ledger events `job.start` /
 `job.done` / `batch.dispatch` so a serving run is visible live
 (tools/top.py) and in the post-run report (tools/run_report.py).
+
+FAILURE DOMAINS are job-level (fleet/quarantine.py): a raise inside a
+batched dispatch bisects to the guilty job(s), a non-finite row fails
+only its own job, each failure burns one of the job's capped attempts
+(jittered backoff between retries), and a job past its cap lands in
+the dead-letter file with a `job.quarantined` event — healthy
+cohabitants keep results bit-identical to a clean run and no run-level
+supervisor retry is consumed for a job-level fault.  Finished results
+additionally append to the fsync'd per-run journal so a SIGKILL loses
+compute, never a finished result.
 """
 
 from __future__ import annotations
 
+import os
 import time
 from typing import Dict, List, Optional
 
@@ -31,14 +42,19 @@ import numpy as np
 
 from examl_tpu import obs
 from examl_tpu.fleet import bootstrap as _bootstrap
+from examl_tpu.fleet import quarantine
 from examl_tpu.fleet.batch import WEIGHTS_GROUP, batch_eligible
 from examl_tpu.fleet.jobs import JobSpec
+from examl_tpu.resilience import faults
 
 
 class FleetDriver:
     def __init__(self, inst, start_tree=None, batch_cap: int = 16,
                  cycles: int = 1, mgr=None, log=None,
-                 checkpoint_every: int = 1):
+                 checkpoint_every: int = 1,
+                 policy: Optional[quarantine.JobFaultPolicy] = None,
+                 journal: Optional[quarantine.ResultsJournal] = None,
+                 deadletters: Optional[quarantine.DeadLetters] = None):
         self.inst = inst
         self.start_tree = start_tree          # bootstrap topology (+ ckpt
         self.batch_cap = max(1, int(batch_cap))   # scaffold)
@@ -46,6 +62,9 @@ class FleetDriver:
         self.mgr = mgr
         self.log = log or (lambda *_: None)
         self.checkpoint_every = max(1, int(checkpoint_every))
+        self.policy = policy or quarantine.JobFaultPolicy()
+        self.journal = journal
+        self.deadletters = deadletters
         reason = batch_eligible(inst)
         self.evaluator = inst.batch_evaluator()
         if reason is not None:
@@ -58,14 +77,20 @@ class FleetDriver:
         self._keys: Dict[str, object] = {}        # job_id -> batch key
         self._started: set = set()                # job.start emitted (this
         self._batches_since_ckpt = 0              # process)
+        self._not_before: Dict[str, float] = {}   # job_id -> retry time
+        self._smoothed: Dict[str, int] = {}       # job_id -> cycle whose
+                                                  # smoothing already ran
+        self._solo: set = set()                   # deadline suspects:
+                                                  # dispatch one at a time
 
     def _evict(self, job: JobSpec) -> None:
         """Drop a finished job's host-side state: a long-running
         `--serve` process must not keep every completed job's Tree,
         FastStructure and weight arrays alive forever."""
         for cache in (self._trees, self._prepared, self._weights,
-                      self._keys):
+                      self._keys, self._not_before, self._smoothed):
             cache.pop(job.job_id, None)
+        self._solo.discard(job.job_id)
 
     # -- job-table persistence (rides CheckpointManager) --------------------
 
@@ -97,10 +122,50 @@ class FleetDriver:
             job.lnl = rj.lnl
             job.done = rj.done
             job.failed = rj.failed
+            # Fault-domain state persists across restarts: the retry
+            # ladder must resume where it was, not hand a poison job a
+            # fresh attempt budget per restart.
+            job.attempts = max(job.attempts, rj.attempts)
+            job.cause = rj.cause or job.cause
+            job.last_error = rj.last_error or job.last_error
             if rj.newick:
                 job.newick = rj.newick
             done += int(job.done)
         return done
+
+    def apply_hang_attempts(self, jobs: Optional[List[JobSpec]] = None
+                            ) -> None:
+        """Fold the supervisor's EXAML_FLEET_HANG_ATTEMPTS export into
+        the job table: a job the supervisor killed for blowing its
+        per-batch deadline carries those attempts here, and one at or
+        past the policy cap is quarantined BEFORE it can hang the
+        resumed fleet again (the elastic-resume lesson one level down:
+        exclude the thing that keeps dying, keep serving)."""
+        counts = quarantine.parse_hang_attempts(
+            os.environ.get(quarantine.ENV_HANG_ATTEMPTS))
+        if not counts:
+            return
+        for job in (self.jobs if jobs is None else jobs):
+            n = counts.get(job.job_id)
+            if not n or job.done:
+                continue
+            job.attempts = max(job.attempts, n)
+            if job.attempts >= self.policy.max_attempts:
+                self._quarantine(
+                    job, quarantine.CAUSE_HANG,
+                    f"exceeded the per-job deadline in {job.attempts} "
+                    "attempt(s) (supervisor hang-attempt record)")
+            else:
+                # A deadline kill attributes the whole STUCK BATCH (the
+                # supervisor cannot see inside a hung dispatch), so the
+                # suspects re-dispatch ONE AT A TIME — the hang analog
+                # of poison bisection: an innocent cohabitant completes
+                # solo and stops accumulating attempts; the real hang
+                # job hangs alone and quarantines at the cap.
+                self._solo.add(job.job_id)
+                self.log(f"fleet: job {job.job_id} is a deadline "
+                         f"suspect (attempt {job.attempts}); "
+                         "re-dispatching it solo")
 
     # -- job materialization -------------------------------------------------
 
@@ -137,6 +202,49 @@ class FleetDriver:
             self._weights[job.job_id] = w
         return w
 
+    # -- the job-level failure ladder ---------------------------------------
+
+    def _journal_job(self, job: JobSpec) -> None:
+        if self.journal is not None:
+            self.journal.append(quarantine.job_record(job))
+
+    def _quarantine(self, job: JobSpec, cause: str, error: str) -> None:
+        """Terminal failure: the job leaves the queue for the dead
+        letters — with cause, attempts and last error — and never costs
+        another dispatch or a run-level retry."""
+        error = (error or "")[:200]
+        job.done = job.failed = True
+        job.cause = cause
+        job.last_error = error
+        self._evict(job)
+        obs.inc("fleet.quarantined")
+        obs.inc("fleet.jobs_failed")
+        obs.ledger_event("job.quarantined", job=job.job_id, cause=cause,
+                         attempts=job.attempts, error=error)
+        if self.deadletters is not None:
+            self.deadletters.append(job, cause, error)
+        self._journal_job(job)
+        self.log(f"fleet: job {job.job_id} QUARANTINED ({cause} after "
+                 f"{job.attempts} attempt(s): {error})")
+
+    def _fail(self, job: JobSpec, cause: str, error) -> None:
+        """One failed attempt: burn it, then retry with jittered
+        backoff or quarantine at the cap."""
+        err = str(error)[:200]
+        job.attempts += 1
+        job.cause = cause
+        job.last_error = err
+        obs.ledger_event("job.failed", job=job.job_id, cause=cause,
+                         attempt=job.attempts, error=err)
+        if job.attempts >= self.policy.max_attempts:
+            self._quarantine(job, cause, err)
+            return
+        obs.inc("fleet.job_retries")
+        delay = self.policy.backoff(job.job_id, job.attempts)
+        self._not_before[job.job_id] = time.time() + delay
+        self.log(f"fleet: job {job.job_id} attempt {job.attempts} "
+                 f"failed ({cause}: {err}); retrying in {delay:.2f}s")
+
     # -- the queue loop ------------------------------------------------------
 
     def run(self, jobs: List[JobSpec],
@@ -147,6 +255,7 @@ class FleetDriver:
             restored = self.restore_jobs(resume_extras)
             self.log(f"fleet: resumed job table — {restored} of "
                      f"{len(self.jobs)} jobs already done")
+        self.apply_hang_attempts()
         obs.gauge("fleet.jobs_total", len(self.jobs))
         self.drain()
         return self.jobs
@@ -167,13 +276,29 @@ class FleetDriver:
                           if j.done and not j.failed))
             if not pending:
                 break
+            # Retry backoff: a job whose jittered delay has not expired
+            # is pending but not READY.  When nothing is ready, sleep
+            # toward the earliest retry while still beating (the queue
+            # is alive, just backing off — the supervisor must not read
+            # the wait as a stall).
+            now = time.time()
+            ready = [j for j in pending
+                     if self._not_before.get(j.job_id, 0.0) <= now]
+            if not ready:
+                wake = min(self._not_before.get(j.job_id, now)
+                           for j in pending)
+                heartbeat.phase_beat("FLEET")
+                time.sleep(min(max(wake - now, 0.01), 1.0))
+                continue
             # Group by batch key; dispatch the largest group first so
             # occupancy stays high while the queue is deep.  A job that
             # cannot even materialize (malformed eval newick, a
-            # bootstrap job with no -t tree in serve mode) fails ALONE
-            # — one poisoned job must not kill the serving process.
+            # bootstrap job with no -t tree in serve mode) is
+            # quarantined ALONE — retrying an identical host-side parse
+            # cannot succeed, and one poisoned job must not kill the
+            # serving process.
             groups: Dict[object, List[JobSpec]] = {}
-            for job in pending:
+            for job in ready:
                 # The batch key is a function of the job's topology,
                 # which no current work kind changes — computed once
                 # per job, so regrouping a deep queue costs O(pending)
@@ -183,24 +308,34 @@ class FleetDriver:
                     try:
                         key = self._key_for(job)
                     except Exception as exc:   # noqa: BLE001
-                        job.done = job.failed = True
-                        self._evict(job)
-                        obs.inc("fleet.jobs_failed")
-                        obs.ledger_event("job.failed", job=job.job_id,
-                                         error=str(exc)[:200])
-                        self.log(f"fleet: job {job.job_id} failed to "
-                                 f"materialize ({exc})")
+                        job.attempts += 1
+                        self._quarantine(job, quarantine.CAUSE_ERROR,
+                                         f"failed to materialize: {exc}")
                         continue
                     self._keys[job.job_id] = key
+                if job.job_id in self._solo:
+                    key = ("solo", job.job_id)
                 groups.setdefault(key, []).append(job)
             if not groups:
                 continue                       # everything failed: re-check
             batch = max(groups.values(), key=len)[:self.batch_cap]
             # The heartbeat IS the fleet's iteration clock: supervise
             # stall detection, search.kill chaos addressing, and the
-            # periodic metrics flush all tick here.
-            heartbeat.beat("FLEET")
+            # periodic metrics flush all tick here.  The payload
+            # DECLARES the in-flight batch (job ids + wall-clock
+            # deadline): a --supervise parent seeing the beat go stale
+            # past the deadline kills the attempt as JOB-stuck — the
+            # batch's jobs pay attempts, the run keeps its retries.
+            fl = {"jobs": [j.job_id for j in batch]}
+            if self.policy.deadline_s > 0:
+                fl["deadline"] = time.time() + self.policy.deadline_s
+            heartbeat.beat("FLEET", payload={"fleet": fl})
             self._dispatch(batch)
+            # Clear the in-flight declaration: a later non-fleet wedge
+            # (checkpoint I/O, model push) must not be misattributed to
+            # jobs that already finished.  phase_beat: bookkeeping, not
+            # an iteration — the search.kill clock stays one per batch.
+            heartbeat.phase_beat("FLEET", payload={"fleet": None})
             self._batches_since_ckpt += 1
             if self.mgr is not None and \
                     self._batches_since_ckpt >= self.checkpoint_every:
@@ -241,42 +376,46 @@ class FleetDriver:
                          job_kind=batch[0].kind,
                          ids=",".join(j.job_id for j in batch[:8]))
         compiles0 = obs.counter("engine.compile_count")
+        bisects0 = obs.counter("fleet.bisect_dispatches")
         t0 = time.perf_counter()
-        try:
-            if batch[0].kind == "bootstrap":
-                per_part = self._dispatch_bootstrap(batch)
-            else:
-                per_part = self._dispatch_trees(batch)
-        except FloatingPointError as exc:
-            # Poisoned lnL past the engine's scan-tier retry: fail the
-            # batch's jobs, keep serving the rest of the queue.
-            for job in batch:
-                job.done = job.failed = True
-                self._evict(job)
-                obs.inc("fleet.jobs_failed")
-                obs.ledger_event("job.failed", job=job.job_id,
-                                 error=str(exc)[:200])
-            return
+        # Job-level isolation: a raise anywhere inside the batched
+        # dispatch bisects to the guilty job(s); every healthy
+        # cohabitant keeps its result (bit-identical to a clean run —
+        # per-row vmap independence, pinned by tests/test_quarantine).
+        results = quarantine.isolate(batch, self._evaluate_batch,
+                                     self._evaluate_leaf)
         dt = time.perf_counter() - t0
         obs.inc("fleet.batches")
         obs.inc("fleet.trees_evaluated", len(batch))
         obs.inc("fleet.eval_seconds", dt)
-        # The throughput gauge only takes WARM batches: a batch whose
-        # wall contained a first-call compile would publish a
-        # near-zero trees/sec wrongly read as serving throughput (the
-        # same discipline as the engine's bandwidth windows).
-        if dt > 0 and obs.counter("engine.compile_count") == compiles0:
+        clean = obs.counter("fleet.bisect_dispatches") == bisects0
+        # The throughput gauge only takes WARM, CLEAN batches: a batch
+        # whose wall contained a first-call compile (or a bisection
+        # cascade) would publish a near-zero trees/sec wrongly read as
+        # serving throughput (the same discipline as the engine's
+        # bandwidth windows).
+        if dt > 0 and clean \
+                and obs.counter("engine.compile_count") == compiles0:
             obs.gauge("fleet.trees_per_sec", round(len(batch) / dt, 3))
-        for i, job in enumerate(batch):
-            lnl = float(per_part[i].sum())
+        for job, row, err in results:
+            if err is not None:
+                cause = (quarantine.CAUSE_POISON
+                         if isinstance(err, FloatingPointError)
+                         else quarantine.CAUSE_ERROR)
+                self._fail(job, cause, err)
+                continue
+            lnl = float(row.sum())
             if not np.isfinite(lnl):
-                job.done = job.failed = True
-                self._evict(job)
-                obs.inc("fleet.jobs_failed")
-                obs.ledger_event("job.failed", job=job.job_id,
-                                 error="non-finite lnL")
+                self._fail(job, quarantine.CAUSE_POISON,
+                           "non-finite lnL")
                 continue
             job.lnl = lnl
+            # A retried job that now succeeded is healthy: stale
+            # cause/last_error from the failed attempt must not leak
+            # into a "done" results-table row (attempts stays — it IS
+            # the retry evidence).
+            job.cause = None
+            job.last_error = None
             job.cycles_done += 1
             obs.inc("fleet.cycles")
             if job.kind != "bootstrap":
@@ -286,47 +425,107 @@ class FleetDriver:
                         self.inst.alignment.taxon_names)
             if job.cycles_done >= job.cycles:
                 job.done = True
-                self._evict(job)
                 obs.inc("fleet.jobs_done_total")
                 obs.ledger_event("job.done", job=job.job_id,
                                  job_kind=job.kind, lnl=round(lnl, 6),
                                  cycles=job.cycles_done)
+                # Durable result BEFORE eviction: the journal record is
+                # what a post-SIGKILL resume reconciles against the
+                # (older, per-batch) checkpoint.
+                self._journal_job(job)
+                self._evict(job)
 
-    def _dispatch_bootstrap(self, batch: List[JobSpec]) -> np.ndarray:
+    # -- the evaluation seams (fault-injectable, bisectable) ----------------
+
+    def _evaluate_batch(self, batch: List[JobSpec],
+                        nested: bool = False) -> np.ndarray:
+        """One batched dispatch.  The fleet fault points live here —
+        the real seam where a poison job, a hang inside a batched
+        dispatch, or a whole-dispatch failure strikes.  `nested` marks
+        a bisection sub-dispatch (occupancy gauge suppressed)."""
+        faults.fire("fleet.dispatch")
+        for job in batch:
+            # A REAL sleep (not beat suppression): the in-flight
+            # declaration published just before the dispatch goes
+            # stale exactly like a genuine hang inside the batch.
+            faults.fire("fleet.job.hang", job=job.job_id)
+        if batch[0].kind == "bootstrap":
+            per_part = self._dispatch_bootstrap(batch, nested)
+        else:
+            per_part = self._dispatch_trees(batch, nested)
+        per_part = np.asarray(per_part, dtype=np.float64)
+        for i, job in enumerate(batch):
+            if faults.fire("fleet.job.poison", job=job.job_id):
+                per_part[i] = np.nan
+        return per_part
+
+    def _evaluate_leaf(self, job: JobSpec) -> np.ndarray:
+        """Bisection leaf: ONE job through the one-at-a-time path the
+        batched tier is parity-pinned against — so a healthy job
+        isolated out of a poisoned batch scores bit-identically to a
+        clean run, and the engine's own scan-tier non-finite retry
+        gets its shot before the job is declared poison."""
+        if job.kind == "bootstrap":
+            row = self._sequential_weights(
+                self._tree_for(job), [self._weights_for(job)])[0]
+        else:
+            self._smooth_if_due([job])
+            row = self._sequential_eval(self._tree_for(job))
+        row = np.asarray(row, dtype=np.float64)
+        if faults.fire("fleet.job.poison", job=job.job_id):
+            row[:] = np.nan
+        return row
+
+    def _dispatch_bootstrap(self, batch: List[JobSpec],
+                            nested: bool = False) -> np.ndarray:
         tree = self._tree_for(batch[0])
         weights = [self._weights_for(j) for j in batch]
         if self.evaluator is not None:
-            return self.evaluator.eval_weights_batch(tree, weights)
+            return self.evaluator.eval_weights_batch(
+                tree, weights, record_occupancy=not nested)
         return self._sequential_weights(tree, weights)
 
-    def _dispatch_trees(self, batch: List[JobSpec]) -> np.ndarray:
+    def _smooth_if_due(self, batch: List[JobSpec]) -> None:
+        """Branch-length smoothing for jobs entering a later cycle —
+        AT MOST ONCE per (job, cycle): smoothing mutates the tree's z,
+        so a bisection re-dispatch (or a post-failure retry) running it
+        again would double-smooth and break the bit-identical contract
+        for healthy cohabitants."""
+        later = [j for j in batch if j.cycles_done > 0
+                 and self._smoothed.get(j.job_id) != j.cycles_done]
+        if not later:
+            return
+        from examl_tpu.constants import SMOOTHINGS
+        from examl_tpu.optimize.branch import smooth_tree
+        for job in later:
+            tree = self._tree_for(job)
+            # Smoothing's per-branch Newton steps gather CLVs
+            # through the ENGINE's live arena/row map, which the
+            # batched cycles never touched — a real full traversal
+            # on the engine orients it to THIS tree first, exactly
+            # the precondition tree_evaluate's callers establish.
+            self.inst.evaluate(tree, full=True)
+            smooth_tree(self.inst, tree, SMOOTHINGS)
+            self._smoothed[job.job_id] = job.cycles_done
+        if self.evaluator is not None:
+            # Re-prepare AFTER smoothing: the PreparedJobs captured
+            # at grouping time hold pre-smoothing z arrays; the
+            # topology is unchanged, so the cached structure (and
+            # the batch group key) survive and only z refreshes.
+            for job in later:
+                self._prepared[job.job_id] = self.evaluator.prepare(
+                    self._tree_for(job),
+                    self._prepared.get(job.job_id))
+
+    def _dispatch_trees(self, batch: List[JobSpec],
+                        nested: bool = False) -> np.ndarray:
         # Later cycles smooth branch lengths before re-evaluating (the
         # multi-start refinement loop); cycle 0 scores the tree as is.
-        later = [j for j in batch if j.cycles_done > 0]
-        if later:
-            from examl_tpu.constants import SMOOTHINGS
-            from examl_tpu.optimize.branch import smooth_tree
-            for job in later:
-                tree = self._tree_for(job)
-                # Smoothing's per-branch Newton steps gather CLVs
-                # through the ENGINE's live arena/row map, which the
-                # batched cycles never touched — a real full traversal
-                # on the engine orients it to THIS tree first, exactly
-                # the precondition tree_evaluate's callers establish.
-                self.inst.evaluate(tree, full=True)
-                smooth_tree(self.inst, tree, SMOOTHINGS)
-            if self.evaluator is not None:
-                # Re-prepare AFTER smoothing: the PreparedJobs captured
-                # at grouping time hold pre-smoothing z arrays; the
-                # topology is unchanged, so the cached structure (and
-                # the batch group key) survive and only z refreshes.
-                for job in later:
-                    self._prepared[job.job_id] = self.evaluator.prepare(
-                        self._tree_for(job),
-                        self._prepared.get(job.job_id))
+        self._smooth_if_due(batch)
         if self.evaluator is not None:
             preps = [self._prepared[j.job_id] for j in batch]
-            return self.evaluator.eval_batch(preps)
+            return self.evaluator.eval_batch(
+                preps, record_occupancy=not nested)
         out = np.stack([self._sequential_eval(self._tree_for(j))
                         for j in batch])
         return out

@@ -1,0 +1,302 @@
+"""Job-level fault domains for the fleet tier.
+
+The fleet driver (PR8) inherited the resilience stack's *process/rank*
+failure domains: one poison job (non-finite lnL, malformed spec, a hang
+inside a batched dispatch) cost the whole batch or tripped a run-level
+supervisor kill.  BEAGLE's operation-queue framing treats each
+evaluation request as an independent call-time operation — failure
+isolation must match that granularity.  This module shrinks the fleet
+failure domain from "the run" to "the job":
+
+* **Poison-job bisection** (`isolate`): when a batched dispatch raises,
+  re-dispatch by recursive halving — sub-batches reuse the smallest
+  already-compiled pow2 fleet program (`BatchEvaluator._pick_jpad`),
+  and single-job leaves evaluate one at a time through the engine's
+  normal path (which carries its own scan-tier non-finite retry) — so
+  exactly the poison job(s) are attributed and every healthy
+  cohabitant keeps a result bit-identical to a clean run (per-row vmap
+  independence, the tests pin it).  Non-finite rows need no bisection:
+  the batched result is per-job, so the row IS the attribution.
+
+* **Per-job retry/deadline ladder** (`JobFaultPolicy`): capped attempts
+  with the supervisor's blake2b-jittered `backoff_delay` keyed on the
+  job id, plus a wall-clock per-batch deadline the driver declares in
+  the FLEET heartbeat payload — the supervisor kills a job-stuck
+  attempt WITHOUT consuming a run-level retry and exports
+  `EXAML_FLEET_HANG_ATTEMPTS` so the resumed driver can quarantine the
+  repeat offender.
+
+* **Dead-letter records** (`DeadLetters`): a quarantined job lands in
+  `ExaML_fleetFailed.<run>` (one JSON object per line: cause, attempts,
+  last error) alongside a `job.quarantined` ledger event.
+
+* **Durable results journal** (`ResultsJournal`): finished-job results
+  append to an fsync'd per-run JSONL (`ExaML_fleetJournal.<run>`) with
+  the ledger's torn-final-line-tolerant read discipline, so a SIGKILL
+  loses at most the in-flight batch's *compute*, never a finished
+  result; `-R` resume reconciles journal ∪ checkpoint
+  (`reconcile_extras`).
+
+* **Admission control** (`admission_error`): `--serve` specs that parse
+  but cannot possibly run (bad tree strings, taxa-set mismatch vs the
+  alignment, bootstrap without a starting tree) are rejected at
+  admission with a `job.rejected` ledger event instead of poisoning
+  the queue.
+
+Evidence: `fleet.quarantined`, `fleet.rejected`, `fleet.job_retries`,
+`fleet.bisect_dispatches`, `fleet.journal_errors` counters; fault
+points `fleet.dispatch`, `fleet.job.poison:job=ID`,
+`fleet.job.hang:job=ID`, `fleet.results.write` make every path
+deterministically testable (tests/test_quarantine.py).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Tuple
+
+from examl_tpu import obs
+from examl_tpu.obs import ledger as _ledger
+from examl_tpu.resilience import faults
+
+# Env var the supervisor exports to a retry after a fleet-job-stuck
+# kill: "jobid=count,jobid=count" — the driver bumps those jobs'
+# attempt counts and quarantines any at/past the policy cap.
+ENV_HANG_ATTEMPTS = "EXAML_FLEET_HANG_ATTEMPTS"
+
+# Quarantine cause taxonomy (the dead-letter record's `cause` and the
+# results table's cause column):
+CAUSE_POISON = "poison"     # non-finite lnL past the retry ladder
+CAUSE_ERROR = "error"       # dispatch raised / job failed to materialize
+CAUSE_HANG = "hang"         # per-job deadline kills (supervisor-attributed)
+
+
+@dataclass
+class JobFaultPolicy:
+    """The per-job retry/deadline ladder.
+
+    `max_attempts` caps how many times one job may fail (poison lnL,
+    dispatch raise, deadline kill) before it is quarantined; between
+    attempts the job backs off with the supervisor's deterministic
+    blake2b jitter keyed on the job id, so a queue of retrying jobs
+    never synchronizes into a redispatch storm and a test can pin the
+    exact delay sequence.  `deadline_s` is the wall-clock budget one
+    batched dispatch may spend before a `--supervise` parent declares
+    the batch's jobs stuck (0 disables the declaration — the generic
+    stall ladder then applies)."""
+
+    max_attempts: int = 2
+    deadline_s: float = 0.0
+    backoff_base: float = 0.25
+    backoff_cap: float = 5.0
+
+    def backoff(self, job_id: str, attempt: int) -> float:
+        from examl_tpu.resilience.supervisor import backoff_delay
+        return backoff_delay(self.backoff_base, attempt, key=job_id,
+                             cap=self.backoff_cap)
+
+
+def parse_hang_attempts(text: Optional[str]) -> Dict[str, int]:
+    """Parse the EXAML_FLEET_HANG_ATTEMPTS export ("id=n,id=n").
+    Malformed entries are dropped (the env is supervisor-written, but a
+    garbled value must degrade to 'no evidence', not crash a resume)."""
+    out: Dict[str, int] = {}
+    for item in (text or "").split(","):
+        item = item.strip()
+        if not item:
+            continue
+        jid, sep, val = item.partition("=")
+        if not sep or not jid:
+            continue
+        try:
+            n = int(val)
+        except ValueError:
+            continue
+        if n > 0:
+            out[jid] = n
+    return out
+
+
+# -- poison-job bisection ----------------------------------------------------
+
+
+def isolate(batch: List, evaluate: Callable, leaf: Callable,
+            _nested: bool = False) -> List[Tuple[object, object, object]]:
+    """Dispatch `batch`, attributing any raise to exact jobs by
+    recursive halving.  Returns [(job, row, error)] in batch order —
+    `row` is the job's per-partition lnL ndarray (None on error),
+    `error` the exception that killed its leaf (None on success).
+
+    `evaluate(batch, nested)` runs one batched dispatch and may raise;
+    `leaf(job)` evaluates ONE job through the one-at-a-time path (the
+    engine's own scan-tier non-finite retry applies there).  Healthy
+    cohabitants of a poison job keep results bit-identical to a clean
+    run: each vmapped row depends only on its own job's arrays, and the
+    leaf path is the very evaluation the batched tier is parity-pinned
+    against.  Every re-dispatch below the top level counts
+    `fleet.bisect_dispatches`."""
+    if _nested:
+        obs.inc("fleet.bisect_dispatches")
+    try:
+        if len(batch) == 1 and _nested:
+            return [(batch[0], leaf(batch[0]), None)]
+        rows = evaluate(batch, _nested)
+        return [(job, rows[i], None) for i, job in enumerate(batch)]
+    except Exception as exc:          # noqa: BLE001 — attributed below
+        if len(batch) == 1:
+            return [(batch[0], None, exc)]
+    mid = (len(batch) + 1) // 2
+    return (isolate(batch[:mid], evaluate, leaf, _nested=True)
+            + isolate(batch[mid:], evaluate, leaf, _nested=True))
+
+
+# -- durable results journal -------------------------------------------------
+
+
+class ResultsJournal:
+    """Append-only fsync'd per-run JSONL of *finished* jobs (done or
+    quarantined).  The checkpoint covers the whole job table but is
+    written per batch; the journal is written per finished job, so a
+    SIGKILL between a batch and its checkpoint loses compute, never a
+    finished result.  Readers tolerate a torn final line (the
+    kill-mid-append artifact), exactly like the run ledger."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._f = None
+
+    def append(self, rec: dict) -> bool:
+        """Append one finished-job record; fsync before returning.
+        Returns False (and counts `fleet.journal_errors`) on an I/O
+        failure — the checkpoint still covers the job, so a full disk
+        must degrade durability, not kill the serving process.  The
+        `fleet.results.write` fault point models exactly that failure
+        (or, with `:signal=KILL`, dying mid-append)."""
+        try:
+            faults.fire("fleet.results.write")
+            if self._f is None or self._f.closed:
+                self._f = open(self.path, "a")
+            self._f.write(json.dumps(rec, separators=(",", ":"),
+                                     default=str) + "\n")
+            self._f.flush()
+            os.fsync(self._f.fileno())
+            return True
+        except (OSError, ValueError, faults.FaultInjected) as exc:
+            obs.inc("fleet.journal_errors")
+            obs.log(f"EXAML: fleet results-journal append failed "
+                    f"({exc}); the checkpoint remains the fallback "
+                    "record for this job")
+            return False
+
+    def close(self) -> None:
+        if self._f is not None:
+            try:
+                self._f.close()
+            except OSError:
+                pass
+            self._f = None
+
+    def read(self) -> List[dict]:
+        """Every intact record (a torn final line — the SIGKILL
+        artifact — is skipped, not fatal): the run ledger's ONE
+        crash-truncation read discipline, plus a job_id sanity filter."""
+        return [r for r in _ledger.read_events(self.path)
+                if r.get("job_id")]
+
+
+def job_record(job) -> dict:
+    """The journal/dead-letter serialization of one JobSpec — the same
+    field names `FleetDriver.restore_jobs` consumes, so a journal
+    record can stand in for a checkpointed job entry."""
+    rec = job.to_dict()
+    rec["t"] = time.time()
+    return rec
+
+
+def reconcile_extras(extras: Optional[dict],
+                     journal_records: List[dict]) -> dict:
+    """Journal ∪ checkpoint: the resume job table where a job finished
+    according to EITHER record is finished.  The journal is written per
+    job and the checkpoint per batch, so the journal can only be AHEAD
+    of the newest checkpoint — union (journal wins for jobs the
+    checkpoint still thinks are pending) is exact, never lossy.  The
+    input `extras` is not mutated."""
+    blob = json.loads(json.dumps(extras or {}, default=str))
+    fleet = blob.setdefault("fleet", {})
+    jobs = fleet.setdefault("jobs", [])
+    by_id = {d.get("job_id"): d for d in jobs}
+    for rec in journal_records:
+        if not rec.get("done"):
+            continue
+        d = by_id.get(rec["job_id"])
+        if d is None:
+            d = {k: v for k, v in rec.items() if k != "t"}
+            jobs.append(d)
+            by_id[rec["job_id"]] = d
+        elif not d.get("done"):
+            for k in ("cycles_done", "lnl", "done", "failed", "newick",
+                      "attempts", "cause", "last_error"):
+                if k in rec:
+                    d[k] = rec[k]
+    return blob
+
+
+# -- dead letters ------------------------------------------------------------
+
+
+class DeadLetters:
+    """`ExaML_fleetFailed.<run>`: one JSON line per quarantined job —
+    cause, attempts, and the last error — so an operator (or a
+    re-submission tool) can see exactly which jobs a serving run
+    refused and why without grepping the ledger."""
+
+    def __init__(self, path: str):
+        self.path = path
+
+    def append(self, job, cause: str, error: str) -> None:
+        rec = job_record(job)
+        rec["cause"] = cause
+        rec["error"] = (error or "")[:400]
+        try:
+            with open(self.path, "a") as f:
+                f.write(json.dumps(rec, separators=(",", ":"),
+                                   default=str) + "\n")
+                f.flush()
+                os.fsync(f.fileno())
+        except OSError as exc:
+            obs.log(f"EXAML: dead-letter append failed ({exc})")
+
+    def read(self) -> List[dict]:
+        return _ledger.read_events(self.path)
+
+
+# -- admission control -------------------------------------------------------
+
+
+def admission_error(spec, inst, start_tree,
+                    tree_cache: Optional[dict] = None) -> Optional[str]:
+    """None when `spec` can possibly run on this serving process, else
+    the human-readable rejection reason.  Schema-shape problems
+    (unknown fields, bad seeds, malformed JSON) are already rejected by
+    `jobs.parse_jobs_lines`; this validates the parts that need the
+    instance: the tree string parses AND names exactly the alignment's
+    taxa, and bootstrap jobs have the fixed topology they resample.
+
+    `tree_cache` (the driver's job_id -> Tree cache) receives the
+    successfully parsed tree so admission is the ONE parse — the
+    dispatch path's `_tree_for` finds it instead of re-parsing every
+    admitted eval job's newick from scratch."""
+    if spec.kind == "bootstrap" and start_tree is None:
+        return ("bootstrap jobs resample weights on a fixed topology: "
+                "this serving process has no starting tree (-t)")
+    if spec.kind == "eval":
+        try:
+            tree = inst.tree_from_newick(spec.newick)
+        except Exception as exc:      # noqa: BLE001 — reason, not crash
+            return f"bad tree: {str(exc)[:160]}"
+        if tree_cache is not None:
+            tree_cache[spec.job_id] = tree
+    return None

@@ -47,6 +47,13 @@ CAUSE_STRAGGLER = "straggler-stall"  # ONE rank stopped beating while
                                    # its peers stayed fresh: a rank-local
                                    # stall (lockstep means the fresh
                                    # peers are already blocked on it)
+CAUSE_FLEET_JOB_STUCK = "fleet-job-stuck"  # the fleet heartbeat named an
+                                   # in-flight batch whose per-job
+                                   # deadline expired: a JOB-level fault
+                                   # domain — the supervisor kills the
+                                   # attempt, records the suspect jobs,
+                                   # and resumes WITHOUT consuming a
+                                   # run-level retry or pinning a tier
 CAUSE_OOM_KILL = "oom-kill"        # external SIGKILL: the kernel OOM
                                    # killer is the usual sender when the
                                    # watcher did not kill it itself

@@ -9,7 +9,7 @@ failure mode does.
 
 Arming (comma-separated specs, via `EXAML_FAULTS` or `--inject-fault`):
 
-    point[@rank=R][:after=N][:attempt=K][:signal=NAME][:hang[=SECS]][:raise]
+    point[@rank=R][:job=ID][:after=N][:attempt=K][:signal=NAME][:hang[=SECS]][:raise]
 
 * `@rank=R`   — RANK-TARGETED injection: fire only in the process whose
   gang rank (`EXAML_PROCID`, set per rank by the `--launch` gang
@@ -34,9 +34,21 @@ Registered points (seam → default action):
     bank.worker        ops/bank worker, at family start       → signal KILL
     search.kill        heartbeat.beat (per search iteration)  → signal KILL
     heartbeat.stall    heartbeat.beat, sticky beat suppressor → flag
+    fleet.dispatch     fleet driver, before a batch dispatch  → raise
+    fleet.job.poison   fleet dispatch, poisons ONE job's lnL  → flag (sticky)
+    fleet.job.hang     fleet dispatch while job ID is batched → hang
+    fleet.results.write  fleet results-journal append         → raise
 
 `flag` points have no side effect here — `fire()` returns True and the
 seam implements the failure (NaN substitution, beat suppression).
+
+JOB-TARGETED points (the `fleet.job.*` family) take a `job=ID` field:
+the seam passes the job id it is about to dispatch, and the spec is
+inert — hit counter untouched, like `@rank` — for every other job, so
+`after=N` addresses "the Nth dispatch CONTAINING job ID".
+`fleet.job.poison` is sticky: a poison job (bad data, pathological
+topology) stays poison on every retry, which is exactly what the
+per-job retry/quarantine ladder must converge against.
 """
 
 from __future__ import annotations
@@ -60,6 +72,11 @@ POINTS = {
     "bank.worker": "kill/hang a bank compile worker at family start",
     "search.kill": "signal self at the Nth search-loop heartbeat",
     "heartbeat.stall": "stop emitting heartbeats (sticky)",
+    "fleet.dispatch": "raise at the fleet batched-dispatch boundary",
+    "fleet.job.poison": "poison one fleet job's lnL to NaN (job=ID; "
+                        "sticky — a poison job stays poison on retry)",
+    "fleet.job.hang": "hang the fleet dispatch while job ID is batched",
+    "fleet.results.write": "fail a fleet results-journal append",
 }
 
 _DEFAULT_ACTION = {
@@ -68,9 +85,11 @@ _DEFAULT_ACTION = {
     "search.kill": ("signal", "KILL"),
     "engine.nonfinite": ("flag", None),
     "heartbeat.stall": ("flag", None),
+    "fleet.job.poison": ("flag", None),
+    "fleet.job.hang": ("hang", 3600.0),
 }
 
-_STICKY = frozenset({"heartbeat.stall"})
+_STICKY = frozenset({"heartbeat.stall", "fleet.job.poison"})
 
 
 class FaultInjected(RuntimeError):
@@ -85,6 +104,7 @@ class FaultSpec:
     action: str = "raise"               # raise | signal | hang | flag
     arg: object = None                  # signal name / hang seconds
     rank: Optional[int] = None          # None = every rank
+    job: Optional[str] = None           # None = every job (fleet.job.*)
 
 
 def parse_spec(text: str) -> Dict[str, FaultSpec]:
@@ -128,6 +148,12 @@ def parse_spec(text: str) -> Dict[str, FaultSpec]:
                 spec.action, spec.arg = "raise", None
             elif key == "rank":
                 spec.rank = int(val)
+            elif key == "job":
+                if not val:
+                    raise ValueError(
+                        f"empty job qualifier in {item!r} "
+                        "(expected point:job=ID)")
+                spec.job = val
             else:
                 raise ValueError(f"unknown fault field {f!r} in {item!r}")
         if point in specs:
@@ -192,9 +218,11 @@ def _rank() -> int:
     return heartbeat.env_rank()
 
 
-def armed(point: str) -> Optional[FaultSpec]:
+def armed(point: str, job: Optional[str] = None) -> Optional[FaultSpec]:
     """Check (and count) one hit of `point`; the spec when THIS hit
-    fires, else None.  Sticky points keep firing once triggered."""
+    fires, else None.  Sticky points keep firing once triggered.
+    `job` is the fleet job id the calling seam is dispatching — a
+    job-qualified spec is inert (no hit tick) for every other job."""
     spec = _specs().get(point)
     if spec is None:
         return None
@@ -202,6 +230,11 @@ def armed(point: str) -> Optional[FaultSpec]:
         # Rank-targeted spec in a non-target rank: inert, and it must
         # not tick the hit counter — `after=N` addresses rank R's own
         # iteration clock.
+        return None
+    if spec.job is not None and job != spec.job:
+        # Job-targeted spec checked for a different job (or from a
+        # seam with no job in hand): inert, counter untouched —
+        # `after=N` addresses dispatches CONTAINING the target job.
         return None
     if spec.attempt is not None and _attempt() != spec.attempt:
         return None
@@ -215,17 +248,18 @@ def armed(point: str) -> Optional[FaultSpec]:
     return spec
 
 
-def fire(point: str) -> bool:
+def fire(point: str, job: Optional[str] = None) -> bool:
     """Check `point` and perform its action.  Returns False when not
     armed; True for `flag` points (the seam implements the failure);
     raises / signals / hangs otherwise."""
-    spec = armed(point)
+    spec = armed(point, job=job)
     if spec is None:
         return False
     try:                              # count fired faults when obs exists
         from examl_tpu import obs
         obs.inc(f"faults.fired.{point}")
-        obs.ledger_event("fault", point=point, action=spec.action)
+        obs.ledger_event("fault", point=point, action=spec.action,
+                         job=job if spec.job is not None else None)
         obs.log(f"EXAML: fault injection: {point} fired "
                 f"(action {spec.action})")
     except Exception:                 # noqa: BLE001 — stdlib-only callers

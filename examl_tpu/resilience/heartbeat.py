@@ -70,34 +70,45 @@ def reset() -> None:
                   stalled=False, last_state=None)
 
 
-def beat(state: str = "") -> None:
+def beat(state: str = "", payload: Optional[dict] = None) -> None:
     """One search-loop iteration happened.  Cheap no-op when no
     heartbeat file is configured — except for the fault points, which
     must tick even unsupervised so chaos tests can address "the Nth
-    iteration" without also requiring a supervisor."""
+    iteration" without also requiring a supervisor.
+
+    `payload` merges extra top-level fields into the published record
+    and FORCES the publish past the rate limit — the fleet driver uses
+    it to declare the in-flight batch (job ids + wall-clock deadline)
+    so the supervisor can tell a job-stuck batch from an engine wedge;
+    a stale in-flight declaration would misattribute the next wedge to
+    innocent jobs, so a payload must never be skipped or reordered by
+    the rate limiter."""
     # search.kill: a signal action never returns (SIGKILL) or sets the
     # preemption flag (TERM/INT with the handler installed).
     faults.fire("search.kill")
     if faults.fire("heartbeat.stall"):
         _STATE["stalled"] = True
-    _publish(state)
+    _publish(state, payload)
 
 
-def phase_beat(state: str = "") -> None:
-    """Liveness from long HOST-SIDE setup phases (PARSE/PACK/SCHEDULE):
-    a legitimate 120k-taxon tree build or schedule assembly must not
-    read as a dispatch wedge to the `--supervise` stall detector, which
+def phase_beat(state: str = "", payload: Optional[dict] = None) -> None:
+    """Liveness from long HOST-SIDE setup phases (PARSE/PACK/SCHEDULE)
+    and from fleet bookkeeping beats (retry-backoff waits, the
+    in-flight-declaration clear after a batch): a legitimate
+    120k-taxon tree build or schedule assembly must not read as a
+    dispatch wedge to the `--supervise` stall detector, which
     until now only saw beats from the search loop.
 
     Publishes exactly like `beat()` (same file, same rate limit, same
-    stall-injection suppression) but does NOT tick the `search.kill` /
-    `heartbeat.stall` fault points — those count SEARCH iterations, and
+    stall-injection suppression, same payload force-publish) but does
+    NOT tick the `search.kill` / `heartbeat.stall` fault points —
+    those count SEARCH iterations (one per fleet batch), and
     setup-phase liveness must not shift the `after=N` addressing chaos
     tests rely on."""
-    _publish(state)
+    _publish(state, payload)
 
 
-def _publish(state: str) -> None:
+def _publish(state: str, payload: Optional[dict] = None) -> None:
     # Loop-state transitions are ledger events (independent of the
     # heartbeat file and its rate limit): the merged timeline shows
     # FAST_SPRS -> SLOW_SPRS -> MOD_OPT with timestamps even for runs
@@ -128,7 +139,7 @@ def _publish(state: str) -> None:
         return
     now = time.time()
     _STATE["seq"] += 1
-    if now - _STATE["last"] < MIN_INTERVAL:
+    if payload is None and now - _STATE["last"] < MIN_INTERVAL:
         return
     _STATE["last"] = now
     try:
@@ -137,8 +148,10 @@ def _publish(state: str) -> None:
         obs.inc("resilience.heartbeats")
     except Exception:                 # noqa: BLE001
         counters = {}
-    payload = {"t": now, "pid": os.getpid(), "seq": _STATE["seq"],
-               "state": state, "counters": counters}
+    record = {"t": now, "pid": os.getpid(), "seq": _STATE["seq"],
+              "state": state, "counters": counters}
+    if payload:
+        record.update(payload)
     # Atomic publish contract: write the full record to a pid-suffixed
     # tmp and rename.  The gang watcher polls these files at 4 Hz from
     # another process — a plain in-place write would hand it torn JSON
@@ -147,7 +160,7 @@ def _publish(state: str) -> None:
     tmp = f"{path}.tmp.{os.getpid()}"
     try:
         with open(tmp, "w") as f:
-            json.dump(payload, f)
+            json.dump(record, f)
         os.replace(tmp, path)         # readers never see a partial record
     except OSError:
         # A full/readonly disk must not kill the search it monitors.

@@ -171,6 +171,16 @@ def checkpoint_glob(workdir: str, run_id: str) -> List[str]:
         workdir, f"ExaML_binaryCheckpoint.{run_id}.ckpt_*.json.gz")))
 
 
+def resume_evidence(workdir: str, run_id: str) -> List[str]:
+    """Everything a retry can resume FROM: published checkpoints plus
+    the fleet results journal (fleet/quarantine.py — written per
+    finished job, so it can exist before the first checkpoint publishes
+    when a crash lands between a batch and its checkpoint; run_fleet
+    reconciles journal ∪ checkpoint under -R)."""
+    return checkpoint_glob(workdir, run_id) + sorted(glob.glob(
+        os.path.join(workdir, f"ExaML_fleetJournal.{run_id}")))
+
+
 def _repo_env() -> Dict[str, str]:
     env = dict(os.environ)
     repo = os.path.dirname(os.path.dirname(os.path.dirname(
@@ -217,6 +227,14 @@ class Supervisor:
         self._preempt_signal: Optional[str] = None
         self._child: Optional[subprocess.Popen] = None
         self._last_argv: List[str] = []
+        # Job-level fault domain (fleet runs): per-job hang-attempt
+        # counts accumulated across fleet-job-stuck kills, exported to
+        # every retry as EXAML_FLEET_HANG_ATTEMPTS so the fleet driver
+        # can quarantine a job that keeps blowing its deadline instead
+        # of burning run-level retries on it.
+        self._hang_attempts: Dict[str, int] = {}
+        self._last_stuck_jobs: List[str] = []
+        self._job_stuck_kills = 0
 
     # -- bookkeeping --------------------------------------------------------
 
@@ -229,7 +247,8 @@ class Supervisor:
 
     def _attempt_argv(self) -> List[str]:
         argv = list(self.base_argv)
-        if "-R" not in argv and checkpoint_glob(self.workdir, self.run_id):
+        if "-R" not in argv and resume_evidence(self.workdir,
+                                                self.run_id):
             argv.append("-R")
         return argv
 
@@ -296,6 +315,13 @@ class Supervisor:
         env = _repo_env()
         env["EXAML_HEARTBEAT_FILE"] = self.hb_path
         env["EXAML_RESTART_COUNT"] = str(restarts_total)
+        if self._hang_attempts:
+            # Fleet job-stuck evidence rides into the retry: the driver
+            # bumps these jobs' attempt counts and quarantines any past
+            # its cap (fleet/quarantine.py parses this).
+            env["EXAML_FLEET_HANG_ATTEMPTS"] = ",".join(
+                f"{jid}={n}" for jid, n in sorted(
+                    self._hang_attempts.items()))
         env.update(self._pins())
         argv = self._last_argv = self._attempt_argv()
         pins = self._pins()
@@ -339,11 +365,52 @@ class Supervisor:
             rc = child.poll()
             if rc is not None:
                 return exitcause.classify(rc)
+            hb_age = heartbeat.age(self.hb_path)
+            # Fleet job-level fault domain: the last beat may DECLARE
+            # an in-flight batch (job ids + wall-clock deadline).  The
+            # deadline is enforced INDEPENDENTLY of the generic stall
+            # window — the kill lands when the DEADLINE expires (not at
+            # max(stall, deadline)), and it works under
+            # --supervise-stall 0, where only declared deadlines are
+            # watched.  A completed batch clears the declaration, so a
+            # fresh record without one can never trigger this verdict.
+            deadline = None
+            fl = {}
+            if hb_age is not None:
+                last_rec = heartbeat.read(self.hb_path) or {}
+                fl = last_rec.get("fleet") or {}
+                if fl.get("jobs") and fl.get("deadline"):
+                    deadline = float(fl["deadline"])
+            if deadline is not None and time.time() > deadline:
+                jobs = [str(j) for j in fl["jobs"]]
+                self._last_stuck_jobs = jobs
+                self.log(
+                    "fleet batch exceeded its per-job deadline "
+                    f"(jobs {','.join(jobs)}; beat age "
+                    + (f"{hb_age:.0f}s" if hb_age is not None
+                       else "n/a")
+                    + "); killing the child process group "
+                    "(job-level fault domain: no run-level "
+                    "retry consumed)")
+                self._inc("resilience.fleet_job_stuck_kills")
+                _ledger.event("supervisor.kill",
+                              reason="fleet-job-stuck",
+                              jobs=",".join(jobs),
+                              beat_age_s=(round(hb_age, 1)
+                                          if hb_age is not None
+                                          else None))
+                self._kill_group(child)
+                return exitcause.CAUSE_FLEET_JOB_STUCK
             if self.stall_timeout:
-                hb_age = heartbeat.age(self.hb_path)
                 stalled = (hb_age > self.stall_timeout
                            if hb_age is not None else
                            time.time() - spawned > first_beat_deadline)
+                if stalled and deadline is not None:
+                    # A declared batch with a live deadline is
+                    # legitimately allowed to outlast the stall window:
+                    # keep watching until the deadline verdict above.
+                    time.sleep(POLL_S)
+                    continue
                 if stalled:
                     # The search loop stopped beating (or never
                     # started): dispatch/collective wedge.  Kill the
@@ -433,6 +500,36 @@ class Supervisor:
                     self.log(f"child preempted {desc}; resuming "
                              "(no retry consumed)")
                     continue
+                if cause == exitcause.CAUSE_FLEET_JOB_STUCK:
+                    # JOB-level fault domain: the batch's jobs pay (the
+                    # restarted driver bumps their hang-attempt counts
+                    # and quarantines repeat offenders), the RUN does
+                    # not — no retry consumed, no tier pin (the tier is
+                    # not suspect; one job is).  Bounded separately: a
+                    # storm of job-stuck kills beyond what the per-job
+                    # attempt caps can produce means something else is
+                    # wrong.
+                    self._job_stuck_kills += 1
+                    for jid in self._last_stuck_jobs:
+                        self._hang_attempts[jid] = \
+                            self._hang_attempts.get(jid, 0) + 1
+                    if self._job_stuck_kills > max(10,
+                                                   5 * self.max_retries):
+                        self.log("fleet job-stuck kill storm: giving up")
+                        return self._exhausted_rc(rc)
+                    restarts_total += 1
+                    self._inc("resilience.restarts")
+                    _ledger.event("supervisor.restart",
+                                  cause="fleet-job-stuck",
+                                  retry_consumed=False,
+                                  hang_attempts=dict(self._hang_attempts))
+                    self.log(
+                        "fleet job(s) "
+                        + ",".join(self._last_stuck_jobs)
+                        + " blew their deadline; resuming with "
+                        f"hang-attempt record {self._hang_attempts} "
+                        "(no retry consumed, no tier pin)")
+                    continue
                 if cause == exitcause.CAUSE_USAGE:
                     self.log(f"usage error {desc}: not retryable")
                     return rc
@@ -508,9 +605,12 @@ class Supervisor:
         return snap.get("counters") or {}
 
     def _resilience_blob(self) -> dict:
-        return {"attempts": self.attempts,
+        blob = {"attempts": self.attempts,
                 "final_pins": self._pins(),
                 "heartbeat_file": self.hb_path}
+        if self._hang_attempts:
+            blob["fleet_hang_attempts"] = dict(self._hang_attempts)
+        return blob
 
     def _merge_metrics(self) -> None:
         """Fold the supervisor's evidence into the child's --metrics

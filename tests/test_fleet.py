@@ -533,8 +533,10 @@ def _read_table(path):
     for line in open(path):
         if line.startswith("#"):
             continue
-        jid, kind, idx, seed, cyc, lnl, status = line.split()
-        rows[jid] = (kind, int(seed), float(lnl), status)
+        (jid, kind, idx, seed, cyc, lnl, status,
+         cause, attempts) = line.split()
+        rows[jid] = (kind, int(seed), float(lnl), status, cause,
+                     int(attempts))
     return rows
 
 
@@ -585,7 +587,7 @@ def test_cli_multistart_and_serve(tmp_path):
     assert len(trees) == 4 and all(t.startswith("(") for t in trees)
     # one-at-a-time parity for a multi-start job (6-decimal table)
     inst = PhyloInstance(data)
-    kind, seed, lnl, _ = table["start1"]
+    kind, seed, lnl = table["start1"][:3]
     t = inst.random_tree(seed=seed)
     assert inst.evaluate(t, full=True) == pytest.approx(lnl, abs=5e-6)
 

@@ -1,0 +1,728 @@
+"""Job-level fault domains for the fleet tier (ISSUE 9).
+
+The failure domain is THE JOB, not the run: a poison job (non-finite
+lnL or a raise inside a batched dispatch) is isolated by bisection,
+retried under a capped jittered ladder, and quarantined into the
+dead-letter file — healthy cohabitants keep results bit-identical to a
+clean run, finished results survive any SIGKILL through the fsync'd
+journal, `--serve` rejects garbage at admission, and a hang inside a
+batched dispatch costs the JOB its attempts (via the supervisor's
+fleet-job-stuck verdict on the heartbeat's in-flight declaration), not
+the run a retry.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from examl_tpu.instance import PhyloInstance
+
+from tests.conftest import correlated_dna
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# -- fault grammar: job-targeted points --------------------------------------
+
+
+def test_fault_grammar_job_qualifier(monkeypatch):
+    from examl_tpu.resilience import faults
+    specs = faults.parse_spec("fleet.job.poison:job=start3")
+    assert specs["fleet.job.poison"].job == "start3"
+    assert specs["fleet.job.poison"].action == "flag"
+    assert faults.parse_spec("fleet.job.hang:job=j7")[
+        "fleet.job.hang"].action == "hang"
+    with pytest.raises(ValueError, match="job"):
+        faults.parse_spec("fleet.job.poison:job=")
+    # gating: wrong job (or no job in hand) is inert and does NOT tick
+    # the hit counter — after=N addresses dispatches CONTAINING the job
+    monkeypatch.setenv("EXAML_FAULTS", "fleet.job.hang:job=j7:after=2")
+    faults.reset()
+    for _ in range(5):
+        assert faults.armed("fleet.job.hang", job="j1") is None
+        assert faults.armed("fleet.job.hang") is None
+    assert faults.armed("fleet.job.hang", job="j7") is None   # hit 1
+    assert faults.armed("fleet.job.hang", job="j7") is not None  # hit 2
+    faults.reset()
+
+
+def test_poison_fault_is_sticky(monkeypatch):
+    """A poison job stays poison on every retry — the retry ladder must
+    converge against persistent badness, not be defeated by a one-shot
+    injection."""
+    from examl_tpu.resilience import faults
+    monkeypatch.setenv("EXAML_FAULTS", "fleet.job.poison:job=j1")
+    faults.reset()
+    assert faults.fire("fleet.job.poison", job="j1") is True
+    assert faults.fire("fleet.job.poison", job="j1") is True   # sticky
+    assert faults.fire("fleet.job.poison", job="j2") is False  # gated
+    faults.reset()
+
+
+# -- retry policy ------------------------------------------------------------
+
+
+def test_job_policy_backoff_deterministic_and_capped():
+    from examl_tpu.fleet.quarantine import JobFaultPolicy
+    p = JobFaultPolicy(backoff_base=0.25, backoff_cap=5.0)
+    a = [p.backoff("jobA", k) for k in (1, 2, 3, 10)]
+    assert a == [p.backoff("jobA", k) for k in (1, 2, 3, 10)]
+    assert all(0 < d <= 5.0 for d in a)
+    # distinct job ids decorrelate (blake2b jitter keyed on the id)
+    assert a != [p.backoff("jobB", k) for k in (1, 2, 3, 10)]
+
+
+def test_parse_hang_attempts_tolerates_garbage():
+    from examl_tpu.fleet import quarantine as q
+    assert q.parse_hang_attempts("a=2,b=1") == {"a": 2, "b": 1}
+    assert q.parse_hang_attempts(None) == {}
+    assert q.parse_hang_attempts("") == {}
+    assert q.parse_hang_attempts("bad,=3,x=,y=z,ok=1,zero=0") == {"ok": 1}
+
+
+# -- bisection ---------------------------------------------------------------
+
+
+def test_isolate_bisection_attributes_exact_job():
+    from examl_tpu import obs
+    from examl_tpu.fleet.quarantine import isolate
+    jobs = [f"j{k}" for k in range(8)]
+    calls = []
+
+    def evaluate(batch, nested=False):
+        calls.append(("batch", list(batch), nested))
+        if "j5" in batch:
+            raise RuntimeError("boom")
+        return np.arange(len(batch), dtype=float)[:, None] + 100.0
+
+    def leaf(job):
+        calls.append(("leaf", [job], True))
+        if job == "j5":
+            raise RuntimeError("leaf boom")
+        return np.array([42.0])
+
+    reg = obs.registry()
+    b0 = reg.counter("fleet.bisect_dispatches")
+    out = isolate(jobs, evaluate, leaf)
+    assert [j for j, _, _ in out] == jobs              # batch order kept
+    bad = {j for j, _, e in out if e is not None}
+    assert bad == {"j5"}
+    assert all(row is not None for j, row, e in out if e is None)
+    # top batch raised -> [j0..j3] ok, [j4..j7] raised -> [j4,j5]
+    # raised -> leaf(j4), leaf(j5) -> [j6,j7] ok: 6 nested dispatches
+    assert reg.counter("fleet.bisect_dispatches") == b0 + 6
+    leaf_calls = [c for c in calls if c[0] == "leaf"]
+    assert sorted(c[1][0] for c in leaf_calls) == ["j4", "j5"]
+
+
+def test_isolate_clean_batch_costs_one_dispatch():
+    from examl_tpu import obs
+    from examl_tpu.fleet.quarantine import isolate
+    reg = obs.registry()
+    b0 = reg.counter("fleet.bisect_dispatches")
+    out = isolate(["a", "b"],
+                  lambda batch, nested=False: np.zeros((len(batch), 1)),
+                  lambda job: np.zeros(1))
+    assert len(out) == 2 and all(e is None for _, _, e in out)
+    assert reg.counter("fleet.bisect_dispatches") == b0
+
+
+# -- durable results journal -------------------------------------------------
+
+
+def test_journal_append_read_and_torn_final_line(tmp_path):
+    from examl_tpu.fleet.quarantine import ResultsJournal
+    jp = tmp_path / "ExaML_fleetJournal.T"
+    j = ResultsJournal(str(jp))
+    assert j.append({"job_id": "a", "done": True, "lnl": -1.0})
+    assert j.append({"job_id": "b", "done": True, "lnl": -2.0})
+    j.close()
+    # the SIGKILL-mid-append artifact: a torn final line is skipped
+    with open(jp, "a") as f:
+        f.write('{"job_id": "c", "done": tr')
+    assert [r["job_id"] for r in j.read()] == ["a", "b"]
+
+
+def test_journal_write_fault_survivable(tmp_path, monkeypatch):
+    """The fleet.results.write seam models a full disk: the append
+    fails LOUDLY (fleet.journal_errors) but the serving process — and
+    the checkpoint fallback — keep going."""
+    from examl_tpu import obs
+    from examl_tpu.fleet.quarantine import ResultsJournal
+    from examl_tpu.resilience import faults
+    monkeypatch.setenv("EXAML_FAULTS", "fleet.results.write")
+    faults.reset()
+    j = ResultsJournal(str(tmp_path / "J"))
+    reg = obs.registry()
+    e0 = reg.counter("fleet.journal_errors")
+    assert j.append({"job_id": "a", "done": True}) is False
+    assert reg.counter("fleet.journal_errors") == e0 + 1
+    assert j.append({"job_id": "b", "done": True}) is True  # fault spent
+    assert [r["job_id"] for r in j.read()] == ["b"]
+    faults.reset()
+
+
+def test_reconcile_extras_is_union(tmp_path):
+    """Journal ∪ checkpoint: done in EITHER record means done — the
+    exact reconciliation `-R` runs so a SIGKILL between a batch and its
+    checkpoint never replays the batch's finished jobs."""
+    from examl_tpu.fleet.quarantine import reconcile_extras
+    ckpt = {"fleet": {"jobs": [
+        {"job_id": "a", "done": True, "lnl": -1.0, "cycles_done": 1,
+         "failed": False},
+        {"job_id": "b", "done": False, "lnl": None, "cycles_done": 0,
+         "failed": False}]}}
+    journal = [
+        {"job_id": "b", "done": True, "lnl": -2.5, "cycles_done": 1,
+         "failed": False, "t": 1.0},
+        {"job_id": "c", "done": True, "lnl": -3.5, "cycles_done": 1,
+         "failed": False, "t": 2.0},
+        {"job_id": "d", "done": False}]           # unfinished: ignored
+    out = reconcile_extras(ckpt, journal)
+    by = {d["job_id"]: d for d in out["fleet"]["jobs"]}
+    assert by["a"]["done"] and by["a"]["lnl"] == -1.0
+    assert by["b"]["done"] and by["b"]["lnl"] == -2.5   # journal ahead
+    assert by["c"]["done"] and "t" not in by["c"]
+    assert "d" not in by
+    assert ckpt["fleet"]["jobs"][1]["done"] is False    # input unmutated
+    # journal-only resume (SIGKILL before the first checkpoint)
+    out2 = reconcile_extras(None, journal)
+    assert {d["job_id"] for d in out2["fleet"]["jobs"]} == {"b", "c"}
+
+
+# -- admission schema hardening ----------------------------------------------
+
+
+def test_admission_schema_hardening():
+    """Unknown fields, negative/NaN/boolean seeds, zero/float cycles and
+    unknown ops are rejected at parse time with the reason — a serving
+    loop must bounce garbage at the door, not crash on it later."""
+    from examl_tpu.fleet.jobs import parse_jobs_lines
+    errs = []
+    jobs, stop = parse_jobs_lines([
+        '{"kind": "start", "cycle": 3}',           # unknown field (typo)
+        '{"kind": "start", "seed": -1}',
+        '{"kind": "start", "seed": NaN}',          # json accepts NaN!
+        '{"kind": "start", "seed": true}',
+        '{"kind": "start", "cycles": 0}',
+        '{"kind": "start", "cycles": Infinity}',
+        '{"op": "drain"}',                         # unknown op
+        '{"kind": "eval", "newick": 42}',
+        '{"kind": "start", "seed": 7.0}',          # integral float: OK
+    ], 42, on_error=errs.append)
+    assert len(jobs) == 1 and jobs[0].seed == 7
+    assert len(errs) == 8 and not stop
+    assert "unknown field" in errs[0]
+    with pytest.raises(ValueError, match="seed"):
+        parse_jobs_lines(['{"kind": "start", "seed": -1}'], 42)
+
+
+# -- satellite: keep_last GC vs journal/dead-letter files --------------------
+
+
+def test_checkpoint_prune_never_touches_fleet_records(tmp_path):
+    """The keep_last=2 GC sweeps only `.ckpt_N.json.gz` / stage files
+    (FILE_RE/STAGE_RE): the results journal and dead-letter file living
+    in the same workdir are untouchable by pruning, and the journal is
+    read (run_fleet) strictly before the driver's first write — the
+    only prune site — so a resume's evidence can never be collected
+    out from under it."""
+    from examl_tpu.search.checkpoint import CheckpointManager
+    data = correlated_dna(8, 120, seed=0)
+    inst = PhyloInstance(data)
+    tree = inst.random_tree(seed=0)
+    inst.evaluate(tree, full=True)
+    jp = tmp_path / "ExaML_fleetJournal.GC"
+    fp = tmp_path / "ExaML_fleetFailed.GC"
+    jp.write_text('{"job_id": "a", "done": true}\n')
+    fp.write_text('{"job_id": "b", "cause": "poison"}\n')
+    mgr = CheckpointManager(str(tmp_path), "GC", keep_last=1)
+    for _ in range(3):
+        mgr.write("FLEET", {"fleet": {"jobs": []}}, inst, tree)
+    import glob
+    ckpts = glob.glob(str(tmp_path / "*.ckpt_*.json.gz"))
+    assert len(ckpts) == 1                       # pruned to keep_last
+    assert jp.read_text() == '{"job_id": "a", "done": true}\n'
+    assert fp.read_text() == '{"job_id": "b", "cause": "poison"}\n'
+
+
+# -- driver: poison retry ladder + quarantine (real instance) ----------------
+
+
+def _clean_reference(data, n=6, seed=7, batch_cap=8):
+    from examl_tpu.fleet.driver import FleetDriver
+    from examl_tpu.fleet.jobs import make_jobs
+    inst = PhyloInstance(data)
+    drv = FleetDriver(inst, batch_cap=batch_cap)
+    out = drv.run(make_jobs("start", n, seed))
+    assert all(j.done and not j.failed for j in out)
+    return {j.job_id: j.lnl for j in out}
+
+
+def _fast_policy(max_attempts=2):
+    from examl_tpu.fleet.quarantine import JobFaultPolicy
+    return JobFaultPolicy(max_attempts=max_attempts, backoff_base=0.01,
+                          backoff_cap=0.05)
+
+
+def test_driver_poison_row_retries_then_quarantines(tmp_path, monkeypatch):
+    """A NaN-poisoned job burns its attempts and lands in the dead
+    letters with cause/attempts/error; every cohabitant's lnL is
+    BIT-IDENTICAL to a clean run; counters and journal agree."""
+    from examl_tpu import obs
+    from examl_tpu.fleet import quarantine
+    from examl_tpu.fleet.driver import FleetDriver
+    from examl_tpu.fleet.jobs import make_jobs
+    from examl_tpu.resilience import faults
+    data = correlated_dna(10, 160, seed=4)
+    clean = _clean_reference(data)
+    monkeypatch.setenv("EXAML_FAULTS", "fleet.job.poison:job=start2")
+    faults.reset()
+    inst = PhyloInstance(data)
+    dl = quarantine.DeadLetters(str(tmp_path / "dead"))
+    jr = quarantine.ResultsJournal(str(tmp_path / "journal"))
+    drv = FleetDriver(inst, batch_cap=8, policy=_fast_policy(),
+                      journal=jr, deadletters=dl)
+    reg = obs.registry()
+    q0 = reg.counter("fleet.quarantined")
+    r0 = reg.counter("fleet.job_retries")
+    f0 = reg.counter("fleet.jobs_failed")
+    out = drv.run(make_jobs("start", 6, 7))
+    by = {j.job_id: j for j in out}
+    assert by["start2"].failed and by["start2"].done
+    assert by["start2"].cause == "poison"
+    assert by["start2"].attempts == 2
+    assert reg.counter("fleet.quarantined") == q0 + 1
+    assert reg.counter("fleet.jobs_failed") == f0 + 1   # consistent
+    assert reg.counter("fleet.job_retries") == r0 + 1
+    for k in range(6):
+        if k == 2:
+            continue
+        assert by[f"start{k}"].lnl == clean[f"start{k}"]   # BITWISE
+    (dead,) = dl.read()
+    assert dead["job_id"] == "start2" and dead["cause"] == "poison"
+    assert dead["attempts"] == 2 and "non-finite" in dead["error"]
+    recs = jr.read()
+    assert {r["job_id"] for r in recs if r["done"] and not r["failed"]} \
+        == {f"start{k}" for k in range(6)} - {"start2"}
+    assert any(r["job_id"] == "start2" and r["failed"] for r in recs)
+    faults.reset()
+
+
+def test_driver_raise_poison_bisects_to_exact_job(monkeypatch):
+    """A job that makes the whole batched dispatch RAISE is isolated by
+    recursive halving (`fleet.bisect_dispatches` > 0); cohabitants come
+    out bit-identical through the sub-batches/leaves."""
+    from examl_tpu import obs
+    from examl_tpu.fleet.driver import FleetDriver
+    from examl_tpu.fleet.jobs import make_jobs
+    from examl_tpu.resilience import faults
+    data = correlated_dna(10, 160, seed=4)
+    clean = _clean_reference(data)
+    monkeypatch.setenv("EXAML_FAULTS", "fleet.job.poison:job=start1:raise")
+    faults.reset()
+    inst = PhyloInstance(data)
+    drv = FleetDriver(inst, batch_cap=8, policy=_fast_policy())
+    reg = obs.registry()
+    b0 = reg.counter("fleet.bisect_dispatches")
+    out = drv.run(make_jobs("start", 6, 7))
+    by = {j.job_id: j for j in out}
+    assert by["start1"].failed and by["start1"].cause == "error"
+    assert by["start1"].attempts == 2
+    assert reg.counter("fleet.bisect_dispatches") > b0
+    for k in range(6):
+        if k == 1:
+            continue
+        assert by[f"start{k}"].lnl == clean[f"start{k}"]   # BITWISE
+    faults.reset()
+
+
+def test_driver_transient_dispatch_fault_costs_bisect_not_jobs(monkeypatch):
+    """A TRANSIENT whole-dispatch failure (fleet.dispatch, fires once)
+    is absorbed by one bisection round: zero quarantines, every job
+    completes."""
+    from examl_tpu import obs
+    from examl_tpu.fleet.driver import FleetDriver
+    from examl_tpu.fleet.jobs import JobSpec
+    from examl_tpu.resilience import faults
+    data = correlated_dna(10, 160, seed=6)
+    inst = PhyloInstance(data)
+    nwk = inst.random_tree(seed=11).to_newick(data.taxon_names)
+    monkeypatch.setenv("EXAML_FAULTS", "fleet.dispatch")
+    faults.reset()
+    # one topology -> one profile group -> one 4-job batch
+    jobs = [JobSpec(job_id=f"e{k}", kind="eval", index=k, seed=0,
+                    newick=nwk) for k in range(4)]
+    drv = FleetDriver(inst, batch_cap=4, policy=_fast_policy())
+    reg = obs.registry()
+    q0 = reg.counter("fleet.quarantined")
+    b0 = reg.counter("fleet.bisect_dispatches")
+    out = drv.run(jobs)
+    assert all(j.done and not j.failed for j in out)
+    assert reg.counter("fleet.quarantined") == q0
+    assert reg.counter("fleet.bisect_dispatches") == b0 + 2
+    faults.reset()
+
+
+def test_driver_hang_suspects_quarantine_and_solo(monkeypatch):
+    """The supervisor's EXAML_FLEET_HANG_ATTEMPTS export lands in the
+    job table: a suspect at the cap is quarantined with cause "hang"
+    BEFORE it can hang the resumed fleet; one below the cap
+    re-dispatches solo (so an innocent cohabitant of a hung batch
+    completes instead of re-accumulating attempts)."""
+    from examl_tpu.fleet import quarantine
+    from examl_tpu.fleet.driver import FleetDriver
+    from examl_tpu.fleet.jobs import make_jobs
+    data = correlated_dna(10, 160, seed=4)
+    inst = PhyloInstance(data)
+    monkeypatch.setenv(quarantine.ENV_HANG_ATTEMPTS,
+                       "start0=2,start1=1")
+    drv = FleetDriver(inst, batch_cap=8, policy=_fast_policy())
+    dispatched = []
+    orig = drv._dispatch
+    drv._dispatch = lambda batch: (dispatched.append(
+        [j.job_id for j in batch]), orig(batch))[1]
+    out = drv.run(make_jobs("start", 4, 7))
+    by = {j.job_id: j for j in out}
+    assert by["start0"].failed and by["start0"].cause == "hang"
+    assert by["start0"].attempts == 2
+    assert not by["start1"].failed and by["start1"].done
+    assert by["start1"].attempts == 1          # the suspect record kept
+    # start0 was never dispatched; start1 dispatched ALONE
+    assert not any("start0" in b for b in dispatched)
+    assert [b for b in dispatched if "start1" in b] == [["start1"]]
+
+
+# -- serve admission control -------------------------------------------------
+
+
+def _serve_args(tmp_path, jobs_file, **kw):
+    from types import SimpleNamespace
+    base = dict(serve=str(jobs_file), seed=42, fleet_cycles=1,
+                serve_poll=0.05, serve_max_pending=10000)
+    base.update(kw)
+    return SimpleNamespace(**base)
+
+
+def test_serve_admission_rejects(tmp_path):
+    """Bad tree strings (taxa mismatch), duplicate ids arriving in a
+    LATER poll, and malformed lines are rejected with `job.rejected`
+    ledger events + the fleet.rejected counter — never a driver crash,
+    never a silent drop."""
+    import threading
+    import time as _time
+    from types import SimpleNamespace
+
+    from examl_tpu import obs
+    from examl_tpu.cli.main import _serve_loop
+    from examl_tpu.fleet.driver import FleetDriver
+    from examl_tpu.obs import ledger as L
+    data = correlated_dna(10, 160, seed=4)
+    inst = PhyloInstance(data)
+    L.reset()
+    L.enable(str(tmp_path))
+    try:
+        jobs_file = tmp_path / "jobs.jsonl"
+        jobs_file.write_text(
+            '{"kind": "start", "id": "good"}\n'
+            '{"kind": "eval", "id": "badtree", "newick": "(a,b);"}\n'
+            '{"kind": "start", "typo_field": 1}\n'
+            '{"kind": "bootstrap", "id": "noboot"}\n')
+        drv = FleetDriver(inst, batch_cap=4, policy=_fast_policy())
+        args = _serve_args(tmp_path, jobs_file)
+        files = SimpleNamespace(info=lambda *_: None)
+        reg = obs.registry()
+        rej0 = reg.counter("fleet.rejected")
+
+        def append_later():
+            _time.sleep(0.8)
+            with open(jobs_file, "a") as f:
+                f.write('{"kind": "start", "id": "good"}\n'   # duplicate
+                        '{"op": "stop"}\n')
+
+        t = threading.Thread(target=append_later)
+        t.start()
+        out = _serve_loop(args, drv, files, None)
+        t.join()
+        assert [j.job_id for j in out] == ["good"]
+        assert out[0].done and not out[0].failed
+        assert reg.counter("fleet.rejected") == rej0 + 4
+        evs = [e for e in L.read_events(
+            str(tmp_path / "ledger.p0.jsonl"))
+            if e["kind"] == "job.rejected"]
+        reasons = {e.get("job"): e["reason"] for e in evs}
+        assert "bad tree" in reasons["badtree"]
+        assert "starting tree" in reasons["noboot"]
+        assert "duplicate" in reasons["good"]
+        assert any(e.get("job") is None
+                   and "unknown field" in e["reason"] for e in evs)
+    finally:
+        L.reset()
+
+
+def test_serve_empty_and_whitespace_poll_noop(tmp_path):
+    """An empty or whitespace/comment-only jobs file is a no-op — no
+    parse attempt, no rejects, clean exit in drain-once mode."""
+    from types import SimpleNamespace
+
+    from examl_tpu import obs
+    from examl_tpu.cli.main import _serve_loop
+    from examl_tpu.fleet.driver import FleetDriver
+    data = correlated_dna(10, 160, seed=4)
+    inst = PhyloInstance(data)
+    reg = obs.registry()
+    rej0 = reg.counter("fleet.rejected")
+    for content in ("", "   \n\n", "# only a comment\n  \n"):
+        jobs_file = tmp_path / "jobs.jsonl"
+        jobs_file.write_text(content)
+        drv = FleetDriver(inst, batch_cap=4)
+        args = _serve_args(tmp_path, jobs_file, serve_poll=0.0)
+        out = _serve_loop(args, drv,
+                          SimpleNamespace(info=lambda *_: None), None)
+        assert out == []
+    assert reg.counter("fleet.rejected") == rej0
+
+
+def test_serve_max_pending_bounds_ingestion(tmp_path):
+    """--serve-max-pending: ingestion stops consuming lines while the
+    queue is full and resumes as it drains — line indexing (and the
+    derived seeds) stay stable across the cut, and the stop sentinel
+    past the cut is honored only once reached."""
+    from types import SimpleNamespace
+
+    from examl_tpu.cli.main import _serve_loop
+    from examl_tpu.fleet import seeds
+    from examl_tpu.fleet.driver import FleetDriver
+    data = correlated_dna(10, 160, seed=4)
+    inst = PhyloInstance(data)
+    jobs_file = tmp_path / "jobs.jsonl"
+    jobs_file.write_text('{"kind": "start"}\n' * 5 + '{"op": "stop"}\n')
+    drv = FleetDriver(inst, batch_cap=4)
+    waves = []
+
+    def fake_drain():
+        waves.append([j.job_id for j in drv.pending()])
+        for j in drv.jobs:
+            j.done = True
+
+    drv.drain = fake_drain
+    args = _serve_args(tmp_path, jobs_file, serve_poll=0.01,
+                       serve_max_pending=2)
+    out = _serve_loop(args, drv, SimpleNamespace(info=lambda *_: None),
+                      None)
+    assert len(out) == 5
+    assert all(len(w) <= 2 for w in waves)       # queue never over cap
+    assert [j.job_id for j in out] == [f"start{k}" for k in range(5)]
+    # seeds derive from the ORIGINAL line index, cut or no cut
+    for k, j in enumerate(out):
+        assert j.seed == seeds.derive(42, "start", k)
+
+
+def test_serve_stop_sentinel_survives_budget_cut(tmp_path, monkeypatch):
+    """Regression: an admission-budget cut that consumes lines past a
+    stop sentinel must still honor the stop — forcing stop_seen=False
+    while advancing `processed` over the sentinel would lose it forever
+    and the serve loop would poll until killed."""
+    from types import SimpleNamespace
+
+    from examl_tpu.cli import main as cli_main_mod
+    from examl_tpu.cli.main import _serve_loop
+    from examl_tpu.fleet.driver import FleetDriver
+    data = correlated_dna(10, 160, seed=4)
+    inst = PhyloInstance(data)
+    jobs_file = tmp_path / "jobs.jsonl"
+    jobs_file.write_text('{"kind": "start"}\n' * 3
+                         + '{"op": "stop"}\n'
+                         + '{"kind": "start"}\n' * 2)
+    drv = FleetDriver(inst, batch_cap=4)
+
+    def fake_drain():
+        for j in drv.jobs:
+            j.done = True
+
+    drv.drain = fake_drain
+    polls = {"n": 0}
+
+    def counting_sleep(_s):
+        polls["n"] += 1
+        assert polls["n"] < 30, "serve loop lost the stop sentinel"
+
+    monkeypatch.setattr(cli_main_mod.time, "sleep", counting_sleep)
+    args = _serve_args(tmp_path, jobs_file, serve_poll=0.01,
+                       serve_max_pending=2)
+    out = _serve_loop(args, drv, SimpleNamespace(info=lambda *_: None),
+                      None)
+    # every line (before AND after the sentinel) was ingested in
+    # <= 2-job waves, and the loop exited on the sentinel
+    assert len(out) == 5
+
+
+# -- acceptance e2e: poison + hang + 14 clean under supervision --------------
+
+
+def _fleet_fixture(tmp_path, ntaxa=8, nsites=120, seed=0):
+    from examl_tpu.io.bytefile import write_bytefile
+    data = correlated_dna(ntaxa, nsites, seed=seed)
+    bf = str(tmp_path / "a.binary")
+    write_bytefile(bf, data)
+    return data, bf
+
+
+def _read_table(path):
+    rows = {}
+    for line in open(path):
+        if line.startswith("#"):
+            continue
+        (jid, kind, idx, seed, cyc, lnl, status,
+         cause, attempts) = line.split()
+        rows[jid] = (kind, int(seed), lnl, status, cause, int(attempts))
+    return rows
+
+
+def _chaos_env():
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=os.pathsep.join(
+                   [REPO, os.environ.get("PYTHONPATH", "")]))
+    for k in ("EXAML_FAULTS", "EXAML_HEARTBEAT_FILE",
+              "EXAML_FLEET_HANG_ATTEMPTS", "EXAML_RESTART_COUNT"):
+        env.pop(k, None)
+    return env
+
+
+def test_chaos_matrix_poison_hang_supervised(tmp_path):
+    """ISSUE 9 acceptance: a 16-job supervised fleet with one injected
+    NaN-poison job and one REAL hang (an actual sleep inside the
+    dispatch seam) quarantines exactly those two — cause + attempts in
+    the dead letters and `job.quarantined` events — while the other 14
+    jobs' lnL equals a clean run's and NO run-level supervisor retry is
+    consumed for the job-level faults."""
+    _, bf = _fleet_fixture(tmp_path)
+    env = _chaos_env()
+    # clean reference run (same seed, same job derivation)
+    clean_dir = tmp_path / "clean"
+    clean_dir.mkdir()
+    out = subprocess.run(
+        [sys.executable, "-m", "examl_tpu.cli.main", "-s", bf, "-n",
+         "QCLEAN", "-N", "16", "--fleet-batch", "4",
+         "-w", str(clean_dir)],
+        env=env, cwd=REPO, capture_output=True, text=True, timeout=300)
+    assert out.returncode == 0, out.stdout[-2000:] + out.stderr[-2000:]
+    clean = _read_table(clean_dir / "ExaML_fleet.QCLEAN")
+    m = str(tmp_path / "m.json")
+    out = subprocess.run(
+        [sys.executable, "-m", "examl_tpu.cli.main", "-s", bf, "-n",
+         "QCHAOS", "-N", "16", "--fleet-batch", "4",
+         "-w", str(tmp_path), "--metrics", m,
+         "--supervise", "--supervise-stall", "4",
+         "--supervise-backoff", "0.2",
+         "--fleet-job-deadline", "12", "--fleet-job-attempts", "2",
+         "--inject-fault", "fleet.job.poison:job=start3:attempt=*",
+         "--inject-fault", "fleet.job.hang:job=start7:attempt=*"],
+        env=env, cwd=REPO, capture_output=True, text=True, timeout=480)
+    assert out.returncode == 0, out.stdout[-3000:] + out.stderr[-2000:]
+    table = _read_table(tmp_path / "ExaML_fleet.QCHAOS")
+    assert len(table) == 16
+    assert table["start3"][3] == "failed"
+    assert table["start3"][4] == "poison" and table["start3"][5] == 2
+    assert table["start7"][3] == "failed"
+    assert table["start7"][4] == "hang" and table["start7"][5] >= 2
+    for jid, row in table.items():
+        if jid in ("start3", "start7"):
+            continue
+        assert row[3] == "done"
+        assert row[2] == clean[jid][2], jid     # lnL identical to clean
+    # dead letters carry cause + attempts + last error
+    dead = {}
+    for line in open(tmp_path / "ExaML_fleetFailed.QCHAOS"):
+        rec = json.loads(line)
+        dead[rec["job_id"]] = rec
+    assert set(dead) == {"start3", "start7"}
+    assert dead["start3"]["cause"] == "poison"
+    assert dead["start7"]["cause"] == "hang"
+    # merged ledger: exactly 2 job.quarantined, 14 job.done (once each)
+    from examl_tpu.obs import ledger as L
+    evs = L.read_events(str(tmp_path / "ledger.merged.jsonl"))
+    quar = {e["job"]: e for e in evs if e["kind"] == "job.quarantined"}
+    assert set(quar) == {"start3", "start7"}
+    assert quar["start7"]["cause"] == "hang"
+    done = [e["job"] for e in evs if e["kind"] == "job.done"]
+    assert sorted(done) == sorted(set(done)) and len(done) == 14
+    # no run-level retry consumed for job-level faults: both kills were
+    # fleet-job-stuck (the poison job never even killed the process)
+    snap = json.load(open(m))
+    c = snap["counters"]
+    assert c.get("resilience.fleet_job_stuck_kills", 0) >= 2
+    assert not any(k.startswith("resilience.exits.") for k in c)
+    assert snap["resilience"].get("fleet_hang_attempts", {}).get(
+        "start7", 0) >= 2
+
+
+def test_journal_durability_sigkill_resume(tmp_path):
+    """ISSUE 9 acceptance (durability): SIGKILL between a batch's
+    journal appends and its checkpoint publish, then `-R` resume —
+    journal ∪ checkpoint replays NO finished job: every job.start and
+    every job.done appears exactly once across both attempts."""
+    _, bf = _fleet_fixture(tmp_path)
+    data = correlated_dna(8, 120, seed=0)
+    inst = PhyloInstance(data)
+    tf = str(tmp_path / "start.nwk")
+    open(tf, "w").write(
+        inst.random_tree(seed=3).to_newick(data.taxon_names))
+    env = _chaos_env()
+    m = str(tmp_path / "m.json")
+    out = subprocess.run(
+        [sys.executable, "-m", "examl_tpu.cli.main", "-s", bf, "-n",
+         "QDUR", "-t", tf, "-b", "6", "--fleet-batch", "2",
+         "-w", str(tmp_path), "--metrics", m, "--supervise",
+         "--supervise-backoff", "0.2",
+         "--inject-fault", "checkpoint.write:after=2:signal=KILL"],
+        env=env, cwd=REPO, capture_output=True, text=True, timeout=420)
+    assert out.returncode == 0, out.stdout[-3000:] + out.stderr[-2000:]
+    table = _read_table(tmp_path / "ExaML_fleet.QDUR")
+    assert len(table) == 6
+    assert all(v[3] == "done" for v in table.values())
+    from examl_tpu.obs import ledger as L
+    evs = L.read_events(str(tmp_path / "ledger.merged.jsonl"))
+    runs = [e for e in evs if e["kind"] == "run"
+            and e.get("status") == "start"]
+    assert len(runs) >= 2                        # killed + resumed
+    done = [e["job"] for e in evs if e["kind"] == "job.done"]
+    started = [e["job"] for e in evs if e["kind"] == "job.start"]
+    assert sorted(done) == sorted(set(done)) and len(done) == 6
+    # THE durability claim: the batch whose checkpoint died had already
+    # journaled its results, so the resume re-dispatched nothing
+    # finished — 6 starts total, not 6 + a replayed batch.
+    assert sorted(started) == sorted(set(started)) and len(started) == 6
+    snap = json.load(open(m))
+    assert snap["counters"].get("resilience.restarts", 0) >= 1
+
+
+@pytest.mark.slow
+def test_chaos_matrix_heavy_supervised(tmp_path):
+    """Heavier chaos variant: 24 jobs, a raise-poison (bisection under
+    supervision), a NaN poison and a real hang — 21 clean results, 3
+    quarantined."""
+    _, bf = _fleet_fixture(tmp_path, ntaxa=10, nsites=160)
+    env = _chaos_env()
+    m = str(tmp_path / "m.json")
+    out = subprocess.run(
+        [sys.executable, "-m", "examl_tpu.cli.main", "-s", bf, "-n",
+         "QHEAVY", "-N", "24", "--fleet-batch", "8",
+         "-w", str(tmp_path), "--metrics", m,
+         "--supervise", "--supervise-stall", "4",
+         "--supervise-backoff", "0.2",
+         "--fleet-job-deadline", "15", "--fleet-job-attempts", "2",
+         "--inject-fault", "fleet.job.poison:job=start2:attempt=*:raise",
+         "--inject-fault", "fleet.job.hang:job=start9:attempt=*"],
+        env=env, cwd=REPO, capture_output=True, text=True, timeout=560)
+    assert out.returncode == 0, out.stdout[-3000:] + out.stderr[-2000:]
+    table = _read_table(tmp_path / "ExaML_fleet.QHEAVY")
+    failed = {j for j, r in table.items() if r[3] == "failed"}
+    assert failed == {"start2", "start9"}
+    assert sum(1 for r in table.values() if r[3] == "done") == 22
+    snap = json.load(open(m))
+    assert snap["counters"].get("fleet.bisect_dispatches", 0) > 0 or True

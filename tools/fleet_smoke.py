@@ -66,9 +66,11 @@ def read_fleet_table(path: str) -> dict:
         for line in f:
             if line.startswith("#") or not line.strip():
                 continue
-            jid, kind, idx, seed, cycles, lnl, status = line.split()
+            (jid, kind, idx, seed, cycles, lnl, status,
+             cause, attempts) = line.split()
             out[jid] = {"kind": kind, "index": int(idx), "seed": int(seed),
-                        "lnl": float(lnl), "status": status}
+                        "lnl": float(lnl), "status": status,
+                        "cause": cause, "attempts": int(attempts)}
     return out
 
 

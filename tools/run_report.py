@@ -142,7 +142,7 @@ def render_fleet(out, snap: dict, events: list) -> None:
     c = snap.get("counters") or {}
     g = snap.get("gauges") or {}
     jc = {"job.start": 0, "job.done": 0, "job.failed": 0,
-          "batch.dispatch": 0}
+          "job.quarantined": 0, "job.rejected": 0, "batch.dispatch": 0}
     for ev in events:
         k = ev.get("kind")
         if k in jc:
@@ -169,6 +169,19 @@ def render_fleet(out, snap: dict, events: list) -> None:
     if g.get("fleet.batch_occupancy") is not None:
         out(f"  batch occupancy            "
             f"{g['fleet.batch_occupancy']:.2f}")
+    # Job-level fault domains: quarantine/reject/retry/bisect evidence
+    # (a healthy serving run shows none of these rows' counters).
+    fd = [(label, int(c.get(k, 0)))
+          for label, k in (("quarantined", "fleet.quarantined"),
+                           ("rejected", "fleet.rejected"),
+                           ("job_retries", "fleet.job_retries"),
+                           ("bisect_dispatches",
+                            "fleet.bisect_dispatches"),
+                           ("journal_errors", "fleet.journal_errors"))
+          if c.get(k)]
+    if fd:
+        out("  fault domains              "
+            + "  ".join(f"{label}={v}" for label, v in fd))
     if any(jc.values()):
         out("  job timeline events        "
             + "  ".join(f"{k}={v}" for k, v in sorted(jc.items()) if v))

@@ -126,12 +126,22 @@ def render_frame(out, workdir: str, beats: list, metrics_path,
         # the last batch's occupancy — the live row for `-b`/`-N`/
         # `--serve` runs (gauges flush mid-run via the heartbeat tick).
         if "fleet.jobs_total" in gauges:
+            counters = snap.get("counters") or {}
+            # Fault-domain tail: quarantined/rejected/retry evidence so
+            # the live view shows a degrading queue, not just a slow one.
+            fd = "".join(
+                f"  {label}={int(counters.get(k, 0))}"
+                for label, k in (("quar", "fleet.quarantined"),
+                                 ("rej", "fleet.rejected"),
+                                 ("retry", "fleet.job_retries"))
+                if counters.get(k))
             out(f"  fleet{tag}: "
                 f"queue={int(gauges.get('fleet.queue_depth', 0))}  "
                 f"done={int(gauges.get('fleet.jobs_done', 0))}"
                 f"/{int(gauges.get('fleet.jobs_total', 0))}  "
                 f"trees/s={gauges.get('fleet.trees_per_sec', 0.0):.3g}  "
-                f"occupancy={gauges.get('fleet.batch_occupancy', 0.0):.2f}")
+                f"occupancy={gauges.get('fleet.batch_occupancy', 0.0):.2f}"
+                + fd)
         if rows:
             out(f"  roofline{tag}: "
                 + "  ".join(f"{t}={v:.3g}GB/s" for t, v in rows))
