@@ -611,11 +611,20 @@ def run_fleet(args, inst, files: RunFiles) -> int:
             + ("checkpoint" if res is not None else "results journal")
             + (f" (+ {len(journal_recs)} journal record(s) reconciled)"
                if journal_recs and res is not None else ""))
+    # Zero-recompile serving: under --serve (a long-lived process that
+    # keeps meeting novel topology profiles) tree jobs route through
+    # the universal interpreter by default; finite -b/-N batches keep
+    # the specialized batched tier (their profiles amortize).
+    # EXAML_FLEET_UNIVERSAL=1 forces routing everywhere, =0 disables.
+    _uni_env = os.environ.get("EXAML_FLEET_UNIVERSAL", "")
+    route_universal = (_uni_env == "1"
+                       or (bool(args.serve) and _uni_env != "0"))
     driver = FleetDriver(inst, start_tree=start_tree,
                          batch_cap=args.fleet_batch,
                          cycles=args.fleet_cycles, mgr=mgr,
                          log=files.info, policy=policy,
-                         journal=journal, deadletters=deadletters)
+                         journal=journal, deadletters=deadletters,
+                         route_universal=route_universal)
     if args.serve:
         jobs = _serve_loop(args, driver, files, resume)
     else:

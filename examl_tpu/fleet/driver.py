@@ -54,7 +54,8 @@ class FleetDriver:
                  checkpoint_every: int = 1,
                  policy: Optional[quarantine.JobFaultPolicy] = None,
                  journal: Optional[quarantine.ResultsJournal] = None,
-                 deadletters: Optional[quarantine.DeadLetters] = None):
+                 deadletters: Optional[quarantine.DeadLetters] = None,
+                 route_universal: bool = False):
         self.inst = inst
         self.start_tree = start_tree          # bootstrap topology (+ ckpt
         self.batch_cap = max(1, int(batch_cap))   # scaffold)
@@ -70,6 +71,43 @@ class FleetDriver:
         if reason is not None:
             self.log(f"fleet: batched tier unavailable ({reason}); "
                      "jobs evaluate one at a time")
+        # Zero-recompile serving (ops/universal.py): with routing on, a
+        # tree job whose fastpath profile was never specialized runs
+        # through the universal interpreter — one banked program per
+        # bucket size, no per-profile compile inside a batch's wall.
+        # A profile that keeps recurring can optionally be PROMOTED to
+        # the ~1.3x-faster specialized batched program after
+        # EXAML_FLEET_SPECIALIZE_AFTER sightings (0 = never promote:
+        # the pure interpreter-serving default).
+        from examl_tpu.ops import fastpath
+        engines = list(inst.engines.values())
+        # The legacy unbounded layout (EXAML_BOUNDED_CHUNKS=0) has no
+        # ladder alphabet: routing would strip batching AND still pay
+        # the per-profile compile after the interpreter declines —
+        # strictly worse than not routing (the same gate
+        # bank._applicability applies to the universal family).
+        self.route_universal = (
+            route_universal and self.evaluator is not None
+            and self.evaluator.fast and bool(engines)
+            and fastpath.bounded_default()
+            and not any(e.universal_off for e in engines))
+        try:
+            self._specialize_after = max(0, int(os.environ.get(
+                "EXAML_FLEET_SPECIALIZE_AFTER", "0") or 0))
+        except ValueError:
+            self._specialize_after = 0
+        if self.route_universal:
+            # The sequential/bisection-leaf paths must route novel
+            # profiles identically, so a quarantine probe is
+            # bit-identical to its batch row AND mints no specialized
+            # compile either.
+            for e in engines:
+                e.route_novel_to_universal = True
+            self.log("fleet: universal interpreter routing ON — novel "
+                     "topology profiles dispatch through the "
+                     "topology-as-data program (EXAML_UNIVERSAL=0 "
+                     "opts out)")
+        self._profiles_seen: Dict[object, int] = {}
         self.jobs: List[JobSpec] = []
         self._trees: Dict[str, object] = {}       # job_id -> Tree
         self._prepared: Dict[str, object] = {}    # job_id -> PreparedJob
@@ -193,7 +231,45 @@ class FleetDriver:
         prep = self.evaluator.prepare(self._tree_for(job),
                                       self._prepared.get(job.job_id))
         self._prepared[job.job_id] = prep
-        return prep.key
+        key = prep.key
+        if isinstance(key, tuple) and key and key[0] == "fast":
+            # Profile-miss observability (batch-key grouping time): a
+            # NOVEL profile used to compile its specialized program
+            # silently inside the next batch's wall — now it is
+            # counted and on the timeline, the before/after evidence
+            # for the zero-recompile claim.  A profile whose
+            # specialized program ALREADY exists (bank warm, an
+            # earlier universal-off run, a promotion) is not a miss
+            # and keeps its ~1.3x-faster specialized dispatch — the
+            # same already-compiled check the engine's routing makes.
+            profile = key[1]
+            seen = self._profiles_seen.get(profile, 0)
+            self._profiles_seen[profile] = seen + 1
+            compiled = self._profile_compiled(profile)
+            if seen == 0 and not compiled:
+                obs.inc("fleet.profile_misses")
+                obs.ledger_event("job.profile_new", job=job.job_id,
+                                 profile_segments=len(profile))
+            if self.route_universal and not compiled and not (
+                    self._specialize_after
+                    and seen + 1 >= self._specialize_after):
+                # Route through the interpreter one job at a time (the
+                # engine's universal tier; batching novel profiles
+                # under vmap would re-trace every switch branch per
+                # job — noted future work).  The job id keys a
+                # singleton group.
+                key = ("uniseq", job.job_id)
+        return key
+
+    def _profile_compiled(self, profile) -> bool:
+        """Does ANY engine already hold a compiled specialized program
+        (one-at-a-time "fast" or batched "fleet") for this profile?"""
+        for eng in self.inst.engines.values():
+            for k in eng._fast_jit_cache:
+                if isinstance(k, tuple) and len(k) > 1 \
+                        and k[0] in ("fast", "fleet") and k[1] == profile:
+                    return True
+        return False
 
     def _weights_for(self, job: JobSpec) -> list:
         w = self._weights.get(job.job_id)
@@ -522,10 +598,16 @@ class FleetDriver:
         # Later cycles smooth branch lengths before re-evaluating (the
         # multi-start refinement loop); cycle 0 scores the tree as is.
         self._smooth_if_due(batch)
-        if self.evaluator is not None:
+        key = self._keys.get(batch[0].job_id)
+        routed = (isinstance(key, tuple) and key
+                  and key[0] == "uniseq")
+        if self.evaluator is not None and not routed:
             preps = [self._prepared[j.job_id] for j in batch]
             return self.evaluator.eval_batch(
                 preps, record_occupancy=not nested)
+        # Sequential: no batched tier, or a universal-routed job — the
+        # instance's evaluate path, where the engine's novel-profile
+        # routing dispatches the topology-as-data interpreter.
         out = np.stack([self._sequential_eval(self._tree_for(j))
                         for j in batch])
         return out

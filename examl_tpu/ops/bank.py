@@ -76,6 +76,9 @@ CORE_FAMILIES = ("traverse", "trav_eval", "evaluate", "newton",
 FALLBACK_ENV = {
     "fast": (("EXAML_FAST_TRAVERSAL", "0"),
              "full traversals pinned to the scan tier"),
+    "universal": (("EXAML_UNIVERSAL", "0"),
+                  "universal interpreter disabled (specialized chunk "
+                  "programs or scan tier)"),
     "whole": (("EXAML_PALLAS", "0"),
               "whole-traversal Pallas kernel disabled (XLA fast path "
               "or scan tier)"),
@@ -178,6 +181,16 @@ def enumerate_families(mode: str = "d", psr: bool = False,
     e = os.environ if env is None else env
     fams = list(CORE_FAMILIES)
     if e.get("EXAML_FAST_TRAVERSAL") != "0" and not psr and not save_memory:
+        # The universal interpreter banks BEFORE the specialized chunk
+        # family (degradation order pallas -> chunk -> universal ->
+        # scan: the fallback target must be warm before anything that
+        # can degrade onto it).  Its family set is tiny and CLOSED —
+        # one program per (alphabet, table bucket, slot bucket,
+        # with_eval), none per topology — which is what converts the
+        # bank from "pre-compile everything you might meet" to
+        # "compile once, serve forever".
+        if e.get("EXAML_UNIVERSAL") != "0":
+            fams.append("universal")
         fams.append("fast")
         if e.get("EXAML_PALLAS") == "whole":
             fams.append("whole")
@@ -195,10 +208,20 @@ def chunk_layout_info() -> dict:
     bank manifest so a cache whose layout knobs differ from the current
     run's is visibly stale (the knobs change the profile alphabet and
     therefore every `fast`-family program shape)."""
-    from examl_tpu.ops import fastpath
+    from examl_tpu.ops import fastpath, universal
     mw, cap, tail = fastpath._knobs()
-    return {"bounded": fastpath.bounded_default(), "min_width": mw,
+    info = {"bounded": fastpath.bounded_default(), "min_width": mw,
             "chunk_cap": cap, "tail_width": tail}
+    # Universal-interpreter coverage: whether the zero-recompile tier
+    # is on and how big its closed class alphabet is — a manifest
+    # reader can tell at a glance that this cache serves ANY topology
+    # through the banked universal family, not just enumerated
+    # profiles.
+    info["universal"] = {
+        "enabled": os.environ.get("EXAML_UNIVERSAL", "") != "0",
+        "alphabet_classes": len(universal.alphabet((mw, cap))),
+    }
+    return info
 
 
 def spec_from_args(args) -> dict:
@@ -243,6 +266,17 @@ def _applicability(inst, family: str) -> Optional[str]:
             return "fast path is GAMMA/dense-only"
         if all(e.force_scan or e.fast_slack == 0 for e in engines):
             return "fast path disabled (EXAML_FAST_TRAVERSAL=0)"
+        return None
+    if family == "universal":
+        from examl_tpu.ops import fastpath
+        if inst.psr or inst.save_memory:
+            return "universal interpreter is GAMMA/dense-only"
+        if all(e.force_scan or e.fast_slack == 0 for e in engines):
+            return "fast path disabled (EXAML_FAST_TRAVERSAL=0)"
+        if all(getattr(e, "universal_off", True) for e in engines):
+            return "universal interpreter disabled (EXAML_UNIVERSAL=0)"
+        if not fastpath.bounded_default():
+            return "legacy unbounded layout (EXAML_BOUNDED_CHUNKS=0)"
         return None
     if family == "whole":
         if not any(e.pallas_whole for e in engines):
@@ -338,6 +372,26 @@ def warm_family(inst, tree, family: str) -> None:
                    + inst._collect(tree, p.back, True))
         inst.run_traversal(entries, full=True)
         inst.evaluate(tree, full=True)
+        return
+    if family == "universal":
+        # The topology-as-data interpreter: pin the tier, dispatch both
+        # variants (traverse-only + fused eval).  The compiled programs
+        # are keyed by bucket sizes, not topology, so THIS warm covers
+        # every later topology whose buckets fit (`pick_pads` reuses
+        # any compiled bucket) — the zero-recompile serving warmup.
+        prior = [e.universal_force for e in engines]
+        for e in engines:
+            e.universal_force = True
+        try:
+            tree.invalidate_all()
+            p = tree.centroid_branch()
+            entries = (inst._collect(tree, p, True)
+                       + inst._collect(tree, p.back, True))
+            inst.run_traversal(entries, full=True)
+            inst.evaluate(tree, full=True)
+        finally:
+            for e, v in zip(engines, prior):
+                e.universal_force = v
         return
     if family == "rate_scan":
         from examl_tpu.optimize.psr import MIN_RATE

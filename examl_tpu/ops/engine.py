@@ -117,6 +117,23 @@ class LikelihoodEngine:
         import os as _fos
         self.force_scan = _fos.environ.get("EXAML_FAST_TRAVERSAL",
                                            "") == "0"
+        # Universal interpreter tier (ops/universal.py): topology-as-
+        # data execution of the SAME bounded chunk layout through one
+        # compiled lax.scan/lax.switch program whose jit key is
+        # bucket sizes + the (kind, width) alphabet, not the
+        # per-topology segment profile.  EXAML_UNIVERSAL=0 opts out
+        # (mirroring EXAML_FAST_TRAVERSAL); "force"/"always" pins every
+        # eligible full traversal to the interpreter — the supervisor's
+        # chunk->universal degradation rung and the equivalence tests'
+        # lever.  Default: available, taken when a serving caller sets
+        # `route_novel_to_universal` and the specialized program for a
+        # profile is not already compiled (zero-recompile serving).
+        self._universal_env = _fos.environ.get("EXAML_UNIVERSAL", "")
+        self.universal_off = self._universal_env == "0"
+        self.universal_force = self._universal_env in ("force", "always")
+        self.route_novel_to_universal = False
+        self._last_universal = False   # the most recent fast dispatch
+                                       # ran the interpreter (tier tag)
         # Slack floor: the bounded chunk layout pads narrow chunks up to
         # the width floor and points the scanned tail's padding
         # sub-chunks at the slack region, so the arena headroom follows
@@ -184,6 +201,13 @@ class LikelihoodEngine:
         # hygiene plus the obs evidence, not a correctness requirement.
         self._sched_cache = OrderedDict()
         self._sched_cache_cap = 8
+        # Universal-interpreter descriptor tables (host arrays derived
+        # from a FastStructure: class ids, slot offsets, padded index
+        # copies), keyed like the structure cache by topology signature
+        # — content-keyed, so staleness is impossible and eviction is
+        # only memory hygiene.
+        self._universal_tables = OrderedDict()
+        self._universal_tables_cap = 8
         self.sharding = sharding
         self.pallas_interpret = _pos.environ.get(
             "EXAML_PALLAS_INTERPRET", "") == "1"
@@ -425,9 +449,12 @@ class LikelihoodEngine:
     def _dispatch_tier(self, fast: bool) -> str:
         """Tier label for the traffic gauges: which program family moved
         the bytes (scan = the wave-batched fallback; chunk = XLA fast
-        path; pallas / whole = the Mosaic tiers)."""
+        path; pallas / whole = the Mosaic tiers; universal = the
+        topology-as-data interpreter)."""
         if not fast:
             return "scan"
+        if self._last_universal:
+            return "universal"
         if self.pallas_whole:
             return "whole"
         if self.use_pallas:
@@ -1000,10 +1027,18 @@ class LikelihoodEngine:
         return fn
 
     def _run_fast_traversal(self, entries: List[TraversalEntry]) -> None:
-        if self.pallas_whole:
+        from examl_tpu.ops import universal
+        if self.pallas_whole and not self.universal_force:
             self._run_whole(entries)
             return
         sched = self._fast_schedule(entries)
+        self._last_universal = False
+        if self._universal_take(sched.profile, with_eval=False):
+            try:
+                self._run_universal_sched(sched)
+                return
+            except universal.UniversalIneligible:
+                obs.inc("engine.universal_ineligible")
         self._note_fast_program(sched.profile)
         fn = self._fast_fn_flat(sched.profile, with_eval=False)
         self.clv, self.scaler = fn(
@@ -1095,6 +1130,7 @@ class LikelihoodEngine:
         if self._sched_cache:
             obs.inc("engine.sched_cache.invalidate")
             self._sched_cache.clear()
+        self._universal_tables.clear()
 
     def _fast_structure(self, flat):
         from examl_tpu.ops import fastpath
@@ -1173,12 +1209,23 @@ class LikelihoodEngine:
 
     def _run_fast_flat(self, flat, p_num=None, q_num=None, z=None):
         """Fast full traversal (and optional fused root evaluation) from
-        a FlatTraversal: cached structure + fresh z only."""
-        from examl_tpu.ops import fastpath
-        if self.pallas_whole:
+        a FlatTraversal: cached structure + fresh z only.  The universal
+        interpreter (ops/universal.py) takes the dispatch when forced or
+        when novel-profile routing is on and no specialized program for
+        this profile exists — same layout, same chunk arithmetic, but a
+        topology-independent jit key."""
+        from examl_tpu.ops import fastpath, universal
+        if self.pallas_whole and not self.universal_force:
             return self._run_whole(flat.to_entries(), p_num, q_num, z)
         with obs.timer("host_schedule"):
             st = self._fast_structure(flat)
+        self._last_universal = False
+        if self._universal_take(st.profile, p_num is not None):
+            try:
+                return self._run_universal_flat(flat, st, p_num, q_num, z)
+            except universal.UniversalIneligible:
+                obs.inc("engine.universal_ineligible")
+        with obs.timer("host_schedule"):
             zl, zr = fastpath.refresh_z(st, flat, self.num_branch_slots,
                                         self.dtype)
         self._note_fast_program(st.profile)
@@ -1200,6 +1247,202 @@ class LikelihoodEngine:
             self.block_part, self.weights, self.tips)
         self._install_row_map(st)
         return np.asarray(out)
+
+    # -- universal interpreter tier (ops/universal.py) ----------------------
+    # Topology-as-data: the bounded layout's packed arrays ship as
+    # RUNTIME data into one compiled lax.scan whose body lax.switches
+    # over the fixed (kind, width) alphabet.  The jit key is
+    # ("universal", alphabet, table_bucket, slot_bucket, with_eval) — a
+    # tiny closed family — so any topology runs through an
+    # already-banked program with zero first-call compiles.  lnL is
+    # bit-identical to the specialized chunk program by construction:
+    # identical chunk sequence, identical `chunk_applier` arithmetic,
+    # identical order (tests/test_universal.py pins it).
+
+    def _universal_take(self, profile, with_eval: bool) -> bool:
+        """Should this full-traversal dispatch run the interpreter?
+        force > routing; routing diverts only profiles whose
+        specialized program is not already compiled (an already-hot
+        profile keeps its ~1.3x-faster specialized dispatch)."""
+        if self.universal_off:
+            return False
+        if self.universal_force:
+            return True
+        if not self.route_novel_to_universal:
+            return False
+        return ("fast", profile, "flat", with_eval) \
+            not in self._fast_jit_cache
+
+    def _universal_akey(self):
+        """(min_width, cap): the layout-knob identity a table's step
+        splitting and a program's switch alphabet must agree on."""
+        from examl_tpu.ops import universal
+        return universal.alphabet_key()
+
+    def _universal_entry(self, profile, base_h, idx_h, cache_key=None):
+        """Descriptor-table cache entry: the host table plus lazily
+        padded per-bucket copies of the descriptor and index arrays
+        (content-keyed by topology signature when available; an entry
+        built under a different alphabet — env-retuned knobs, a grown
+        arena — rebuilds, since class ids index the alphabet)."""
+        from examl_tpu.ops import universal
+        akey = self._universal_akey()
+        if cache_key is not None:
+            ent = self._universal_tables.get(cache_key)
+            if ent is not None and ent["akey"] == akey:
+                self._universal_tables.move_to_end(cache_key)
+                return ent
+        ent = {"table": universal.build_table(profile, base_h, akey),
+               "idx": idx_h, "desc": {}, "pads": {}, "akey": akey}
+        if cache_key is not None:
+            self._universal_tables[cache_key] = ent
+            while len(self._universal_tables) > self._universal_tables_cap:
+                self._universal_tables.popitem(last=False)
+        return ent
+
+    def _universal_minted(self, akey, with_eval: bool):
+        """The (table_bucket, slot_bucket) pairs whose interpreter
+        program is ACTUALLY resident in the jit cache right now —
+        derived from the cache keys rather than shadow state, so every
+        invalidation path (LRU eviction, the Pallas-failure bulk
+        clear, an env knob retune changing the alphabet key) keeps
+        `pick_pads` honest for free."""
+        return {(k[2], k[3]) for k in self._fast_jit_cache
+                if isinstance(k, tuple) and len(k) == 5
+                and k[0] == "universal" and k[1] == akey
+                and k[4] == with_eval}
+
+    def _universal_args(self, ent, with_eval: bool):
+        """(npad, ppad, desc, idx) for one dispatch: buckets picked
+        from the compiled-program set (replay padding is idempotent,
+        so any larger compiled bucket serves correctly).  The padded
+        descriptor and index arrays are memoized per bucket on the
+        entry DEVICE-RESIDENT — like FastStructure's packed arrays, a
+        cached serving dispatch ships only the two fresh z arrays."""
+        from examl_tpu.ops import universal
+        table = ent["table"]
+        npad, ppad = universal.pick_pads(
+            self._universal_minted(ent["akey"], with_eval),
+            table.n_chunks, table.slots)
+        desc = ent["desc"].get(npad)
+        if desc is None:
+            desc = ent["desc"][npad] = jax.device_put(
+                list(universal.pad_table(table, npad)))
+        idx = ent["pads"].get(ppad)
+        if idx is None:
+            idx = ent["pads"][ppad] = jax.device_put(
+                [universal.pad_slots(np.asarray(a), ppad)
+                 for a in ent["idx"]])
+        return npad, ppad, desc, idx
+
+    def _run_universal_flat(self, flat, st, p_num=None, q_num=None,
+                            z=None):
+        """Interpreter dispatch from a cached FastStructure: descriptor
+        table + packed index copies are cached per topology signature;
+        only the z arrays (padded to the slot bucket) are fresh."""
+        from examl_tpu.ops import fastpath
+        with_eval = p_num is not None
+        with obs.timer("host_schedule"):
+            ent = self._universal_entry(
+                st.profile, np.asarray(st.base),
+                (st.lidx, st.ridx, st.lcode, st.rcode),
+                cache_key=flat.topo_key)
+            npad, ppad, desc, idx = self._universal_args(ent, with_eval)
+            zl, zr = fastpath.refresh_z(st, flat, self.num_branch_slots,
+                                        self.dtype, total_slots=ppad)
+        return self._universal_dispatch(st, desc, idx, zl, zr, npad,
+                                        ppad, p_num, q_num, z)
+
+    def _run_universal_sched(self, sched, p_num=None, q_num=None,
+                             z=None):
+        """Interpreter dispatch from a legacy entry-list FastSchedule
+        (bank warming, entry-list callers): same program, host arrays
+        padded on the fly (no topology signature to cache under)."""
+        from examl_tpu.ops import universal
+        with_eval = p_num is not None
+        base_h, li, ri, lc, rc, zl_h, zr_h = sched._host
+        with obs.timer("host_schedule"):
+            ent = self._universal_entry(sched.profile, base_h,
+                                        (li, ri, lc, rc))
+            npad, ppad, desc, idx = self._universal_args(ent, with_eval)
+            zl = jnp.asarray(universal.pad_slots(zl_h, ppad, fill=1),
+                             self.dtype)
+            zr = jnp.asarray(universal.pad_slots(zr_h, ppad, fill=1),
+                             self.dtype)
+        return self._universal_dispatch(sched, desc, idx, zl, zr, npad,
+                                        ppad, p_num, q_num, z)
+
+    def _universal_dispatch(self, sched, desc, idx, zl, zr, npad: int,
+                            ppad: int, p_num, q_num, z):
+        """Ship the padded table + packed layout as data through the
+        bucketed interpreter program and install the layout's row map
+        (identical post-state to the specialized dispatch)."""
+        with_eval = p_num is not None
+        obs.inc("engine.universal_dispatches")
+        tag = "." + self._obs_tag
+        obs.gauge("engine.universal_steps" + tag, npad)
+        obs.gauge("engine.universal_slots" + tag, ppad)
+        # The interpreter is ONE device op, but its scan walks npad
+        # dependent steps — the launch-floor term the regime classifier
+        # uses (same accounting as the scan tier's wave count).
+        self._last_dispatch_ops = npad
+        self._last_universal = True
+        fn = self._universal_fn(npad, ppad, with_eval)
+        cls, slot, cbase = desc
+        li, ri, lc, rc = idx
+        if not with_eval:
+            self.clv, self.scaler = fn(
+                self.clv, self.scaler, cls, slot, cbase, li, ri, lc, rc,
+                zl, zr, self.models, self.block_part, self.tips)
+            self._install_row_map(sched)
+            return None
+        zv = jnp.asarray(_z_slots(z, self.num_branch_slots),
+                         dtype=self.dtype)
+        self.clv, self.scaler, out = fn(
+            self.clv, self.scaler, cls, slot, cbase, li, ri, lc, rc, zl,
+            zr, jnp.int32(self._gidx_of(sched, p_num)),
+            jnp.int32(self._gidx_of(sched, q_num)), zv, self.models,
+            self.block_part, self.weights, self.tips)
+        self._install_row_map(sched)
+        return np.asarray(out)
+
+    def _universal_fn(self, npad: int, ppad: int, with_eval: bool):
+        """The ONE jitted interpreter program per (alphabet, buckets,
+        with_eval) — the `("universal", ...)` cache family, with its
+        own compile-watchdog label via `_cache_family`.  Always the
+        plain-XLA chunk kernel: the interpreter is the portability rung
+        below the chunk tier (pallas -> chunk -> universal -> scan),
+        and a Mosaic kernel in every switch branch would multiply the
+        compile surface of the tier whose point is compiling once."""
+        from examl_tpu.ops import fastpath, universal
+        akey = self._universal_akey()
+        key = ("universal", akey, npad, ppad, with_eval)
+        fn = self.cache_get(key)
+        if fn is not None:
+            return fn
+        alpha = universal.alphabet(akey)
+
+        def run(clv, scaler, cls, slot, cbase, lidx, ridx, lcode, rcode,
+                zl, zr, dm, block_part, tips):
+            apply = fastpath.chunk_applier(dm, block_part, tips,
+                                           self.scale_exp,
+                                           self.fast_precision)
+            return universal.run_universal(
+                alpha, cls, slot, cbase, lidx, ridx, lcode, rcode, zl,
+                zr, clv, scaler, apply.values)
+
+        def impl_eval(clv, scaler, cls, slot, cbase, lidx, ridx, lcode,
+                      rcode, zl, zr, p_idx, q_idx, zv, dm, block_part,
+                      weights, tips):
+            clv, scaler = run(clv, scaler, cls, slot, cbase, lidx, ridx,
+                              lcode, rcode, zl, zr, dm, block_part, tips)
+            lnl = kernels.root_log_likelihood(
+                dm, block_part, weights, tips, clv, scaler, p_idx, q_idx,
+                zv, self.num_parts, self.scale_exp, self.ntips, None)
+            return clv, scaler, lnl
+
+        return self.cache_put(key, jax.jit(
+            impl_eval if with_eval else run, donate_argnums=(0, 1)))
 
     @property
     def pallas_precision(self):
@@ -1307,6 +1550,7 @@ class LikelihoodEngine:
         # scan-tier wave count here would wrongly stamp a whole-tier
         # bandwidth number dispatch-bound).
         self._last_dispatch_ops = 1
+        self._last_universal = False
         sched, args = self._whole_args(entries)
         if p_num is None:
             fn = self._whole_fn(sched.e_real, with_eval=False)
@@ -1612,9 +1856,16 @@ class LikelihoodEngine:
         return np.asarray(out)
 
     def _trav_eval_fast(self, entries, p_num, q_num, z) -> np.ndarray:
-        if self.pallas_whole:
+        from examl_tpu.ops import universal
+        if self.pallas_whole and not self.universal_force:
             return self._run_whole(entries, p_num, q_num, z)
         sched = self._fast_schedule(entries)
+        self._last_universal = False
+        if self._universal_take(sched.profile, with_eval=True):
+            try:
+                return self._run_universal_sched(sched, p_num, q_num, z)
+            except universal.UniversalIneligible:
+                obs.inc("engine.universal_ineligible")
         self._note_fast_program(sched.profile)
         fn = self._fast_fn_flat(sched.profile, with_eval=True)
         zv = jnp.asarray(_z_slots(z, self.num_branch_slots),

@@ -601,12 +601,16 @@ def build_structure(flat, ntips: int,
                          num_rows=n, max_write=layout.max_write)
 
 
-def refresh_z(st: FastStructure, flat, num_slots: int, dtype):
+def refresh_z(st: FastStructure, flat, num_slots: int, dtype,
+              total_slots: Optional[int] = None):
     """The DYNAMIC half of a cached schedule: permute the traversal's
     branch-length vectors into packed chunk-slot order (canonical swap
     applied; padding slots at z=1, replay slots repeating their source
     entry's z) — pure numpy fancy indexing, the only per-call host work
-    on a schedule-cache hit."""
+    on a schedule-cache hit.  `total_slots` (>= the structure's packed
+    slot count) pads the result with z=1 rows for the universal
+    interpreter's bucketed slot axis (ops/universal.py); the padding
+    rows are never read."""
     zl_f = flat.zl
     zr_f = flat.zr
     if zl_f.shape[1] != num_slots:
@@ -614,13 +618,15 @@ def refresh_z(st: FastStructure, flat, num_slots: int, dtype):
         zl_f = np.stack([z_slots(z, num_slots) for z in zl_f])
         zr_f = np.stack([z_slots(z, num_slots) for z in zr_f])
     P = st.z_src.shape[0]
+    Pout = P if total_slots is None else total_slots
+    assert Pout >= P, (Pout, P)
     ok = st.z_src >= 0
     src = st.z_src[ok]
     sw = st.z_swap[ok, None]
-    zl = np.ones((P, num_slots))
-    zr = np.ones((P, num_slots))
-    zl[ok] = np.where(sw, zr_f[src], zl_f[src])
-    zr[ok] = np.where(sw, zl_f[src], zr_f[src])
+    zl = np.ones((Pout, num_slots))
+    zr = np.ones((Pout, num_slots))
+    zl[:P][ok] = np.where(sw, zr_f[src], zl_f[src])
+    zr[:P][ok] = np.where(sw, zl_f[src], zr_f[src])
     return jax.device_put([np.asarray(zl, dtype), np.asarray(zr, dtype)])
 
 
@@ -737,7 +743,14 @@ def chunk_applier(models: kernels.DeviceModels, block_part: jax.Array,
                                    (((3,), (2,)), ((0, 1), (0, 1))),
                                    precision=precision)
 
-    def apply(clv, scaler, ch: FastChunk):
+    def values(clv, scaler, ch: FastChunk):
+        """The chunk's COMPUTED rows, no write: (v [W, B, lane, R, K]
+        in the compute dtype, sc [W, B, lane]).  Split out of `apply`
+        so the universal interpreter (ops/universal.py) can run the
+        identical arithmetic inside a `lax.switch` branch while the
+        arena write stays OUTSIDE the conditional — XLA copies carry
+        buffers that are written inside cond branches (measured 7.6x
+        on CPU), but read-only operands flow through for free."""
         rows, B, lane, R_, K = clv.shape
         RK = R_ * K
         pl = kernels.p_matrices_wave(models, ch.zl)         # [W,M,R,K,K]
@@ -759,15 +772,19 @@ def chunk_applier(models: kernels.DeviceModels, block_part: jax.Array,
         needs = jnp.max(jnp.abs(v), axis=3) < minlik
         v = jnp.where(needs[..., None], v * two_e, v)
         sc = sc + needs.astype(jnp.int32)
+        return v.reshape(W, B, lane, R_, K), sc
+
+    def apply(clv, scaler, ch: FastChunk):
+        v, sc = values(clv, scaler, ch)
         z0 = jnp.zeros((), ch.base.dtype if hasattr(ch.base, "dtype")
                        else jnp.int32)
         clv = jax.lax.dynamic_update_slice(
-            clv, v.reshape(W, B, lane, R_, K).astype(clv.dtype),
-            (ch.base, z0, z0, z0, z0))
+            clv, v.astype(clv.dtype), (ch.base, z0, z0, z0, z0))
         scaler = jax.lax.dynamic_update_slice(scaler, sc,
                                               (ch.base, z0, z0))
         return clv, scaler
 
+    apply.values = values
     return apply
 
 

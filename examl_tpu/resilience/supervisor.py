@@ -17,9 +17,13 @@ plugin must not be able to hang the watcher).  It:
 * restarts from the newest checkpoint (`-R` once one exists) with
   capped retries, exponential backoff, and ESCALATING degradation pins
   mirroring the bank's escape hatches: retry 1 pins `EXAML_PALLAS=0`
-  (pallas→chunk), retry 2+ pins the scan tier
-  (`EXAML_FAST_TRAVERSAL=0`, `EXAML_BATCH_SCAN=0`,
-  `EXAML_BATCH_THOROUGH=0`) — the one tier hardware-proven everywhere;
+  (pallas→chunk), retry 2 pins `EXAML_UNIVERSAL=force`
+  (chunk→universal: the topology-as-data interpreter compiles ONE
+  program regardless of topology, so a wedge inside a per-profile
+  chunk compile cannot recur), retry 3+ pins the scan tier
+  (`EXAML_FAST_TRAVERSAL=0`, `EXAML_UNIVERSAL=0`,
+  `EXAML_BATCH_SCAN=0`, `EXAML_BATCH_THOROUGH=0`) — the one tier
+  hardware-proven everywhere;
 * treats a child exit of EXIT_PREEMPTED (75) as RESUMABLE: restarted
   immediately, no retry consumed (capped separately so a preemption
   storm still terminates);
@@ -64,8 +68,10 @@ from examl_tpu.resilience import exitcause, heartbeat
 DEGRADE_LADDER = (
     {},
     {"EXAML_PALLAS": "0"},
+    {"EXAML_PALLAS": "0", "EXAML_UNIVERSAL": "force"},
     {"EXAML_PALLAS": "0", "EXAML_FAST_TRAVERSAL": "0",
-     "EXAML_BATCH_SCAN": "0", "EXAML_BATCH_THOROUGH": "0"},
+     "EXAML_UNIVERSAL": "0", "EXAML_BATCH_SCAN": "0",
+     "EXAML_BATCH_THOROUGH": "0"},
 )
 
 DEFAULT_RETRIES = 3
@@ -258,8 +264,15 @@ class Supervisor:
 
     def _escalate(self, cause: str) -> None:
         if cause in exitcause.TIER_SUSPECT:
-            self.degrade_level = min(self.degrade_level + 1,
-                                     len(DEGRADE_LADDER) - 1)
+            # The step guarantees the scan-tier FLOOR (the ladder's
+            # last rung) is reached within the configured retry
+            # budget: a --supervise-retries smaller than the ladder
+            # skips intermediate rungs (e.g. the universal rung)
+            # rather than dying with the hardware-proven floor
+            # untried.
+            floor = len(DEGRADE_LADDER) - 1
+            step = -(-floor // max(1, self.max_retries))   # ceil div
+            self.degrade_level = min(self.degrade_level + step, floor)
 
     def _retry_delay(self, retries: int) -> float:
         return backoff_delay(self.backoff, retries, key=self.run_id)

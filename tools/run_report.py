@@ -182,6 +182,17 @@ def render_fleet(out, snap: dict, events: list) -> None:
     if fd:
         out("  fault domains              "
             + "  ".join(f"{label}={v}" for label, v in fd))
+    # Universal-interpreter serving: how many NOVEL profiles arrived
+    # (each one would have been a silent first-call compile before the
+    # topology-as-data tier) and how many dispatches the interpreter
+    # took — profile_misses > 0 with universal_dispatches > 0 and zero
+    # unbanked first calls IS the zero-recompile-serving evidence.
+    if c.get("fleet.profile_misses") or c.get("engine.universal_dispatches"):
+        out("  universal interpreter      "
+            f"profile_misses={int(c.get('fleet.profile_misses', 0))}"
+            f"  dispatches={int(c.get('engine.universal_dispatches', 0))}"
+            f"  unbanked_first_calls="
+            f"{int(c.get('engine.first_calls.unbanked', 0))}")
     if any(jc.values()):
         out("  job timeline events        "
             + "  ".join(f"{k}={v}" for k, v in sorted(jc.items()) if v))

@@ -133,7 +133,9 @@ def render_frame(out, workdir: str, beats: list, metrics_path,
                 f"  {label}={int(counters.get(k, 0))}"
                 for label, k in (("quar", "fleet.quarantined"),
                                  ("rej", "fleet.rejected"),
-                                 ("retry", "fleet.job_retries"))
+                                 ("retry", "fleet.job_retries"),
+                                 ("uni", "engine.universal_dispatches"),
+                                 ("prof_miss", "fleet.profile_misses"))
                 if counters.get(k))
             out(f"  fleet{tag}: "
                 f"queue={int(gauges.get('fleet.queue_depth', 0))}  "
