@@ -47,17 +47,24 @@ import time
 # Budget epoch shared across parent/worker/fallback children: a child
 # inherits the ORIGINAL process's start time via EXAML_BENCH_T0 so time
 # already spent counts against the wall budget (the budget protects the
-# driver's bench window, not any single process).
-try:
-    _EPOCH0 = float(os.environ.get("EXAML_BENCH_T0") or time.time())
-except ValueError:
-    _EPOCH0 = time.time()
+# driver's bench window, not any single process).  The env read happens
+# at first use, not import (GL004: an import-time read would freeze the
+# value before a parent could set it), against this process's start
+# time as the fallback epoch.
+_T0 = time.time()
 
 import numpy as np
 
 
+def _epoch0() -> float:
+    try:
+        return float(os.environ.get("EXAML_BENCH_T0") or _T0)
+    except ValueError:
+        return _T0
+
+
 def _elapsed() -> float:
-    return time.time() - _EPOCH0
+    return time.time() - _epoch0()
 
 
 def _budget() -> float:
@@ -753,7 +760,7 @@ def _probe_backend(budgets=(180, 60)):
 
 def _child_env(cpu: bool) -> dict:
     env = dict(os.environ)
-    env["EXAML_BENCH_T0"] = repr(_EPOCH0)
+    env["EXAML_BENCH_T0"] = repr(_epoch0())
     if not cpu:
         return env
     env["JAX_PLATFORMS"] = "cpu"

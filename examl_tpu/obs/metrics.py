@@ -276,6 +276,9 @@ def maybe_autoflush(force: bool = False) -> bool:
     try:
         with open(tmp, "w") as f:
             json.dump(snap, f, sort_keys=True, default=str)
+        # graftlint: disable=GL007 -- best-effort mid-run flush on the
+        # heartbeat clock (never-raise contract); the exit snapshot
+        # overwrites it, and a lost flush costs one cadence of counters.
         os.replace(tmp, path)
     except OSError:
         try:

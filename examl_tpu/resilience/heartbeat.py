@@ -161,6 +161,9 @@ def _publish(state: str, payload: Optional[dict] = None) -> None:
     try:
         with open(tmp, "w") as f:
             json.dump(record, f)
+        # graftlint: disable=GL007 -- atomicity-only publish: a beat is
+        # superseded within seconds and a lost one reads as one stall
+        # tick; fsync per beat would put disk latency on the loop clock.
         os.replace(tmp, path)         # readers never see a partial record
     except OSError:
         # A full/readonly disk must not kill the search it monitors.

@@ -432,6 +432,9 @@ class CheckpointManager:
         from examl_tpu.resilience import faults
         faults.fire("checkpoint.publish")
         try:
+            # graftlint: disable=GL007 -- the blob was fsynced at STAGE
+            # time (_write_gang fsyncs tmp before renaming to .stage);
+            # phase 2 is a rename of already-durable bytes.
             os.replace(blob, self.path_for(n))
         except FileNotFoundError:
             return True               # a peer won the publish race

@@ -1,0 +1,123 @@
+"""CLI: python -m tools.graftlint [--strict] [--json FILE]
+(always lints the whole configured scan scope; use --select/--ignore
+to narrow to specific checks)
+
+Exit codes (stable, for CI):
+    0  clean (all findings suppressed with justifications, or none)
+    1  active findings
+    2  usage / internal error
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def _repo_root() -> str:
+    return os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    # Allow `python tools/graftlint` and `python -m tools.graftlint`
+    # from the repo root alike.
+    root = _repo_root()
+    if root not in sys.path:
+        sys.path.insert(0, root)
+    from tools.graftlint import core
+
+    ap = argparse.ArgumentParser(
+        prog="tools.graftlint",
+        description="JAX-aware static analysis for this repo's "
+                    "dispatch, observability and durability invariants "
+                    "(GL001-GL007).")
+    ap.add_argument("--root", default=root,
+                    help="repo root to lint (default: this checkout)")
+    ap.add_argument("--strict", action="store_true",
+                    help="also fail on stale baseline entries and "
+                         "reasonless pragmas (the CI mode)")
+    ap.add_argument("--json", default=None, metavar="FILE",
+                    help="write machine-readable findings JSON "
+                         "('-' for stdout)")
+    ap.add_argument("--baseline", default=None,
+                    help="baseline suppression file (default: "
+                         "tools/graftlint/baseline.json under --root)")
+    ap.add_argument("--select", default=None,
+                    help="comma-separated check ids to run (GL001,...)")
+    ap.add_argument("--ignore", default=None,
+                    help="comma-separated check ids to skip")
+    ap.add_argument("--show-suppressed", action="store_true",
+                    help="print suppressed findings too")
+    args = ap.parse_args(argv)
+
+    try:
+        project = core.load_project(args.root)
+    except OSError as exc:
+        print(f"graftlint: cannot load project: {exc}", file=sys.stderr)
+        return 2
+    select = set(args.select.split(",")) if args.select else None
+    ignore = set(args.ignore.split(",")) if args.ignore else None
+    bpath = args.baseline or os.path.join(args.root, "tools", "graftlint",
+                                          "baseline.json")
+    baseline, bproblems = core.load_baseline(bpath)
+
+    try:
+        findings = core.run_checks(project, select=select, ignore=ignore)
+    except Exception as exc:            # noqa: BLE001 — CI needs exit 2
+        import traceback
+        traceback.print_exc()
+        print(f"graftlint: internal error: {exc}", file=sys.stderr)
+        return 2
+    findings = core.apply_suppressions(project, findings, baseline)
+    findings.extend(bproblems)
+    if args.strict:
+        # An entry can only be marked used by a check that actually
+        # ran: under --select/--ignore, skipped checks' entries are
+        # not stale, just out of scope for this run.
+        ran = [e for e in baseline
+               if (select is None or e.check in select)
+               and (ignore is None or e.check not in ignore)]
+        findings.extend(core.stale_baseline_findings(ran, bpath))
+
+    active = [f for f in findings if f.suppressed is None]
+    if not args.strict:
+        active = [f for f in active if f.check != "GL000"]
+    suppressed = [f for f in findings if f.suppressed is not None]
+
+    for f in active:
+        print(f)
+    if args.show_suppressed:
+        for f in suppressed:
+            print(f)
+
+    counts: dict = {}
+    for f in active:
+        counts[f.check] = counts.get(f.check, 0) + 1
+    summary = ("clean" if not active else
+               "  ".join(f"{k}={v}" for k, v in sorted(counts.items())))
+    print(f"graftlint: {len(project.files)} files, "
+          f"{len(active)} active finding(s), "
+          f"{len(suppressed)} suppressed  [{summary}]")
+
+    if args.json:
+        blob = {
+            "version": 1,
+            "files": len(project.files),
+            "active": [f.as_dict() for f in active],
+            "suppressed": [f.as_dict() for f in suppressed],
+            "counts": counts,
+        }
+        if args.json == "-":
+            json.dump(blob, sys.stdout, indent=1, sort_keys=True)
+            print()
+        else:
+            with open(args.json, "w", encoding="utf-8") as fh:
+                json.dump(blob, fh, indent=1, sort_keys=True)
+    return 1 if active else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

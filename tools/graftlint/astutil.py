@@ -1,0 +1,130 @@
+"""Shared AST helpers for the graftlint checks (stdlib-only)."""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Tuple
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """`a.b.c` for a Name/Attribute chain, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def call_name(call: ast.Call) -> Optional[str]:
+    """Dotted name of a call's callee (None for computed callees)."""
+    return dotted_name(call.func)
+
+
+def const_str(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def fstring_prefix(node: ast.AST) -> Optional[str]:
+    """The leading literal text of an f-string (`f"faults.fired.{p}"`
+    -> "faults.fired."), or None if the node is not a JoinedStr or has
+    no leading literal — the checks treat such names as dynamic."""
+    if not isinstance(node, ast.JoinedStr) or not node.values:
+        return None
+    head = node.values[0]
+    if isinstance(head, ast.Constant) and isinstance(head.value, str):
+        return head.value
+    return None
+
+
+def walk_scoped(tree: ast.AST) -> Iterator[Tuple[ast.AST, Tuple[str, ...]]]:
+    """Yield (node, function_stack) for every node; an empty stack means
+    the code runs at IMPORT time (module/class scope — and a function's
+    DEFAULT ARGUMENTS and decorators, which evaluate at `def` time, not
+    call time, so an env read hidden in a default freezes at import
+    like any module-level read)."""
+    def visit(node: ast.AST, stack: Tuple[str, ...]):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield child, stack
+                defside = (child.decorator_list + child.args.defaults
+                           + [d for d in child.args.kw_defaults
+                              if d is not None])
+                for expr in defside:
+                    yield expr, stack
+                    yield from visit(expr, stack)
+                for stmt in child.body:
+                    yield stmt, stack + (child.name,)
+                    yield from visit(stmt, stack + (child.name,))
+            elif isinstance(child, ast.Lambda):
+                yield child, stack
+                yield from visit(child, stack + ("<lambda>",))
+            else:
+                yield child, stack
+                yield from visit(child, stack)
+    yield from visit(tree, ())
+
+
+def module_functions(tree: ast.AST) -> Dict[str, List[ast.FunctionDef]]:
+    """All FunctionDefs in a module (any nesting depth), by bare name —
+    the resolver for callables passed by name to lax.cond/lax.switch."""
+    out: Dict[str, List[ast.FunctionDef]] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            out.setdefault(node.name, []).append(node)
+    return out
+
+
+def module_str_constants(tree: ast.AST) -> Dict[str, str]:
+    """Module-level `NAME = "literal"` assignments (the ENV_VAR =
+    "EXAML_FAULTS" idiom) so reads through the constant resolve."""
+    out: Dict[str, str] = {}
+    for node in ast.iter_child_nodes(tree):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            tgt, val = node.targets[0], const_str(node.value)
+            if isinstance(tgt, ast.Name) and val is not None:
+                out[tgt.id] = val
+    return out
+
+
+def local_assignments(fn: ast.AST) -> Dict[str, List[ast.AST]]:
+    """name -> [value exprs] assigned anywhere inside `fn` (simple
+    Name targets only; good enough for key-provenance tracing)."""
+    out: Dict[str, List[ast.AST]] = {}
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    out.setdefault(tgt.id, []).append(node.value)
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            if isinstance(node.target, ast.Name):
+                out.setdefault(node.target.id, []).append(node.value)
+    return out
+
+
+def param_names(fn: ast.AST) -> List[str]:
+    if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef,
+                           ast.Lambda)):
+        return []
+    a = fn.args
+    names = [p.arg for p in a.posonlyargs + a.args + a.kwonlyargs]
+    if a.vararg:
+        names.append(a.vararg.arg)
+    if a.kwarg:
+        names.append(a.kwarg.arg)
+    return names
+
+
+def contains_call_to(node: ast.AST, names: frozenset) -> bool:
+    """True if any call inside `node` targets a bare or dotted name
+    whose final component is in `names`."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call):
+            cn = call_name(sub)
+            if cn is not None and cn.rsplit(".", 1)[-1] in names:
+                return True
+    return False

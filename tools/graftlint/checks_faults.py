@@ -1,0 +1,117 @@
+"""GL006: fault-point drift.
+
+`resilience/faults.py` POINTS is the chaos-testing contract: every
+registered injection point must be wired into a real seam
+(`faults.fire(...)` somewhere in the runtime), exercised by at least
+one chaos test or CI spec (a point nobody arms is a recovery path
+nobody proves), and listed in the README failure-taxonomy section so
+operators know which domain pays.  The reverse direction too: a
+`fire()` call naming an unregistered point would silently never arm —
+`parse_spec` rejects unknown points at ARM time, but a seam-side typo
+just makes the chaos test pass vacuously.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Set, Tuple
+
+from tools.graftlint.astutil import call_name, const_str
+from tools.graftlint.core import Finding, Project
+
+FAULTS_MODULE = "examl_tpu/resilience/faults.py"
+_FIRE_METHODS = frozenset({"fire", "armed"})
+
+
+def _mentioned(point: str, text: str) -> bool:
+    """Whole-token presence: a point `fleet.job` must not pass because
+    the text contains `fleet.job.poison` — a trailing `.` (deeper
+    segment) or name character means a DIFFERENT point."""
+    return re.search(r"(?<![a-z0-9_.])" + re.escape(point)
+                     + r"(?![a-z0-9_.])", text) is not None
+
+
+def _registered_points(lf) -> Dict[str, int]:
+    """POINTS dict keys -> line, parsed from the faults module AST."""
+    for node in ast.walk(lf.tree):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name) and \
+                node.targets[0].id == "POINTS" and \
+                isinstance(node.value, ast.Dict):
+            out = {}
+            for k in node.value.keys:
+                s = const_str(k)
+                if s:
+                    out[s] = k.lineno
+            return out
+    return {}
+
+
+def _fire_sites(lf) -> List[Tuple[str, int]]:
+    out = []
+    for node in ast.walk(lf.tree):
+        if not isinstance(node, ast.Call) or not node.args:
+            continue
+        cn = call_name(node) or ""
+        last = cn.rsplit(".", 1)[-1]
+        if last in _FIRE_METHODS and ("faults" in cn or cn == last):
+            s = const_str(node.args[0])
+            if s:
+                out.append((s, node.lineno))
+    return out
+
+
+def check_fault_drift(project: Project) -> List[Finding]:
+    findings: List[Finding] = []
+    faults_file = project.get(FAULTS_MODULE)
+    if faults_file is None or faults_file.tree is None:
+        return findings
+    points = _registered_points(faults_file)
+    if not points:
+        return findings
+
+    fired: Set[str] = set()
+    for f in project.files:
+        if f.tree is None or f.path == FAULTS_MODULE:
+            continue
+        for name, line in _fire_sites(f):
+            fired.add(name)
+            if name not in points:
+                findings.append(Finding(
+                    "GL006", f.path, line,
+                    f"fire()/armed() names unregistered fault point "
+                    f"{name!r} — it can never arm (POINTS in "
+                    "resilience/faults.py does not list it), so the "
+                    "chaos path it guards passes vacuously",
+                    f"{f.path}::fault-unregistered::{name}"))
+
+    # Evidence corpora: chaos tests + CI workflow specs arm points via
+    # EXAML_FAULTS / --inject-fault strings; a plain-text scan is the
+    # right fidelity for grammar strings like "search.kill:after=2".
+    test_text = "\n".join(t.source for t in project.test_files)
+    test_text += "\n" + project.workflows
+
+    for point, line in sorted(points.items()):
+        if point not in fired:
+            findings.append(Finding(
+                "GL006", FAULTS_MODULE, line,
+                f"registered fault point {point!r} is never fired by "
+                "any runtime seam — dead injection point",
+                f"{FAULTS_MODULE}::fault-unfired::{point}"))
+        if not _mentioned(point, test_text):
+            findings.append(Finding(
+                "GL006", FAULTS_MODULE, line,
+                f"registered fault point {point!r} is never armed by "
+                "any test or CI spec — its recovery path is unproven",
+                f"{FAULTS_MODULE}::fault-untested::{point}"))
+        if not _mentioned(point, project.readme):
+            findings.append(Finding(
+                "GL006", FAULTS_MODULE, line,
+                f"registered fault point {point!r} missing from the "
+                "README failure-taxonomy table",
+                f"{FAULTS_MODULE}::fault-undocumented::{point}"))
+    return findings
+
+
+check_fault_drift.check_id = "GL006"

@@ -1,0 +1,76 @@
+"""GL007: fsync-then-rename durability.
+
+An `os.replace` publish is only crash-durable if the staged file was
+fsynced first — rename is metadata, and a power loss can publish a
+zero-length or torn file (the r04/r05 window postmortems are exactly
+this class of loss).  The checkpoint layer learned this in PR3
+(`_fsync_file` before every publish, directory fsync after); this
+check makes the discipline structural: every function that calls
+`os.replace` must contain an fsync-marked call lexically BEFORE the
+replace.  Atomicity-only publishes (heartbeats, derived/re-mergeable
+artifacts, best-effort flushes) opt out with an inline pragma whose
+justification names why durability is not required — the pragma is
+the documentation.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List
+
+from tools.graftlint import config
+from tools.graftlint.astutil import call_name
+from tools.graftlint.core import Finding, Project
+
+
+def _walk_local(scope: ast.AST) -> Iterator[ast.AST]:
+    """Descendants of `scope` WITHOUT entering nested function bodies —
+    "within the same function" is the check's unit of reasoning."""
+    stack = list(ast.iter_child_nodes(scope))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _scopes(tree: ast.AST):
+    yield tree, "<module>"
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node, node.name
+
+
+def check_durability(project: Project) -> List[Finding]:
+    findings: List[Finding] = []
+    for f in project.files:
+        if f.tree is None:
+            continue
+        for scope, name in _scopes(f.tree):
+            replaces: List[int] = []
+            fsyncs: List[int] = []
+            for node in _walk_local(scope):
+                if not isinstance(node, ast.Call):
+                    continue
+                cn = call_name(node) or ""
+                last = cn.rsplit(".", 1)[-1]
+                if last == "replace" and cn.endswith("os.replace"):
+                    replaces.append(node.lineno)
+                elif config.FSYNC_MARKER in last:
+                    fsyncs.append(node.lineno)
+            for rline in replaces:
+                if any(fl < rline for fl in fsyncs):
+                    continue
+                findings.append(Finding(
+                    "GL007", f.path, rline,
+                    f"os.replace publish in {name}() with no fsync of "
+                    "the staged file beforehand — rename without fsync "
+                    "can publish a torn file after power loss; fsync "
+                    "first, or pragma-justify an atomicity-only publish",
+                    f"{f.path}::durability::{name}"))
+    return findings
+
+
+check_durability.check_id = "GL007"

@@ -1,0 +1,290 @@
+"""graftlint core: files, findings, pragmas, baseline, runner.
+
+Design contract shared by every check:
+
+* A `Finding` carries a STABLE identity (`ident`, line-number-free) so
+  baseline entries survive unrelated edits, plus the line for humans.
+* Suppression is two-layer: an inline pragma on the offending line
+  (`# graftlint: disable=GL007 -- reason`) or a baseline entry in
+  tools/graftlint/baseline.json.  Both REQUIRE a justification; a
+  reasonless pragma and a stale baseline entry are themselves findings
+  (GL000) so suppressions can never rot silently.
+* Checks are pure functions of a `Project` (parsed lint targets +
+  evidence corpora) — no imports of the code under analysis, no jax,
+  no I/O beyond what Project loaded.  The whole pass is AST + string
+  work and runs in seconds, which is what lets CI gate on it.
+"""
+
+from __future__ import annotations
+
+import ast
+import fnmatch
+import json
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from tools.graftlint import config
+
+# The justification tail is syntactically optional so that the natural
+# reasonless form (`# graftlint: disable=GL007` with no `--`) still
+# PARSES as a pragma — and then fails as GL000, instead of silently
+# not suppressing while the operator believes it does.
+PRAGMA_RE = re.compile(
+    r"#\s*graftlint:\s*disable=([A-Z0-9,\s]+?)"
+    r"\s*(?:(?:--|—)\s*(.*))?$")
+
+NO_BLANKET = frozenset({"GL001", "GL007"})
+
+
+@dataclass
+class Finding:
+    check: str                    # "GL001".."GL007", "GL000" for meta
+    path: str                     # repo-relative posix path
+    line: int
+    message: str
+    ident: str                    # stable identity: "<path>::<detail>"
+    suppressed: Optional[str] = None   # why it does not count, if ever
+
+    def as_dict(self) -> dict:
+        return {"check": self.check, "path": self.path, "line": self.line,
+                "message": self.message, "ident": self.ident,
+                "suppressed": self.suppressed}
+
+    def __str__(self) -> str:
+        sup = f"  [suppressed: {self.suppressed}]" if self.suppressed else ""
+        return f"{self.path}:{self.line}: {self.check} {self.message}{sup}"
+
+
+@dataclass
+class LintFile:
+    path: str                     # repo-relative posix path
+    source: str
+    tree: Optional[ast.AST] = None
+    error: Optional[str] = None   # syntax error text, if unparseable
+    pragmas: Dict[int, Tuple[frozenset, str]] = field(default_factory=dict)
+
+    @classmethod
+    def parse(cls, path: str, source: str) -> "LintFile":
+        f = cls(path=path, source=source)
+        try:
+            f.tree = ast.parse(source)
+        except SyntaxError as exc:
+            f.error = str(exc)
+        for i, line in enumerate(source.splitlines(), start=1):
+            m = PRAGMA_RE.search(line)
+            if m:
+                codes = frozenset(c.strip() for c in m.group(1).split(",")
+                                  if c.strip())
+                f.pragmas[i] = (codes, (m.group(2) or "").strip())
+        return f
+
+
+@dataclass
+class Project:
+    """Parsed lint targets plus the evidence corpora the cross-file
+    checks diff against.  Tests construct these directly from strings;
+    the CLI loads them from the repo root."""
+    files: List[LintFile]
+    test_files: List[LintFile] = field(default_factory=list)
+    readme: str = ""
+    workflows: str = ""           # concatenated workflow yml text
+    root: str = ""
+
+    def get(self, path: str) -> Optional[LintFile]:
+        for f in self.files:
+            if f.path == path:
+                return f
+        return None
+
+
+def _read(path: str) -> str:
+    with open(path, encoding="utf-8") as f:
+        return f.read()
+
+
+def load_project(root: str) -> Project:
+    files: List[LintFile] = []
+    for top in config.LINT_ROOTS:
+        full = os.path.join(root, top)
+        if os.path.isfile(full):
+            files.append(LintFile.parse(top, _read(full)))
+            continue
+        for dirpath, dirnames, filenames in os.walk(full):
+            dirnames[:] = sorted(d for d in dirnames
+                                 if d != "__pycache__")
+            for fn in sorted(filenames):
+                if not fn.endswith(".py"):
+                    continue
+                p = os.path.join(dirpath, fn)
+                rel = os.path.relpath(p, root).replace(os.sep, "/")
+                files.append(LintFile.parse(rel, _read(p)))
+    tests: List[LintFile] = []
+    tdir = os.path.join(root, config.EVIDENCE_TEST_ROOT)
+    if os.path.isdir(tdir):
+        for fn in sorted(os.listdir(tdir)):
+            if fn.endswith(".py"):
+                rel = f"{config.EVIDENCE_TEST_ROOT}/{fn}"
+                tests.append(LintFile.parse(rel, _read(os.path.join(tdir,
+                                                                    fn))))
+    readme = ""
+    for doc in config.EVIDENCE_DOCS:
+        p = os.path.join(root, doc)
+        if os.path.isfile(p):
+            readme += _read(p) + "\n"
+    workflows = ""
+    for wdir in config.EVIDENCE_WORKFLOWS:
+        full = os.path.join(root, wdir)
+        if os.path.isdir(full):
+            for fn in sorted(os.listdir(full)):
+                if fn.endswith((".yml", ".yaml")):
+                    workflows += _read(os.path.join(full, fn)) + "\n"
+    return Project(files=files, test_files=tests, readme=readme,
+                   workflows=workflows, root=root)
+
+
+# -- baseline ---------------------------------------------------------------
+
+
+@dataclass
+class BaselineEntry:
+    check: str
+    ident: str                    # fnmatch pattern against Finding.ident
+    justification: str
+    used: bool = False
+
+
+def load_baseline(path: str) -> Tuple[List[BaselineEntry], List[Finding]]:
+    """Entries plus GL000 findings for malformed ones.  The policy the
+    ISSUE pins: every entry carries a justification, and GL001/GL007 —
+    the measured-pitfall checks — accept no wildcard idents (a blanket
+    suppression would un-pin the pitfall)."""
+    problems: List[Finding] = []
+    if not os.path.isfile(path):
+        return [], problems
+    rel = os.path.basename(path)
+    try:
+        with open(path, encoding="utf-8") as f:
+            blob = json.load(f)
+    except (OSError, ValueError) as exc:
+        problems.append(Finding("GL000", rel, 1,
+                                f"unreadable baseline: {exc}",
+                                f"{rel}::baseline"))
+        return [], problems
+    entries: List[BaselineEntry] = []
+    for i, e in enumerate(blob.get("entries", [])):
+        check = str(e.get("check", ""))
+        ident = str(e.get("ident", ""))
+        just = str(e.get("justification", "")).strip()
+        if not (check and ident and just):
+            problems.append(Finding(
+                "GL000", rel, 1,
+                f"baseline entry {i} missing check/ident/justification",
+                f"{rel}::baseline[{i}]"))
+            continue
+        if check in NO_BLANKET and ("*" in ident or "?" in ident):
+            problems.append(Finding(
+                "GL000", rel, 1,
+                f"baseline entry {i}: blanket suppression of {check} is "
+                f"not allowed (ident {ident!r} contains a wildcard)",
+                f"{rel}::baseline[{i}]"))
+            continue
+        entries.append(BaselineEntry(check, ident, just))
+    return entries, problems
+
+
+# -- runner -----------------------------------------------------------------
+
+
+def all_checks() -> list:
+    from tools.graftlint import (checks_env, checks_faults, checks_io,
+                                 checks_jax, checks_obs)
+    return [
+        checks_jax.check_cond_write,        # GL001
+        checks_jax.check_jit_key,           # GL002
+        checks_jax.check_host_sync,         # GL003
+        checks_env.check_env_registry,      # GL004
+        checks_obs.check_obs_drift,         # GL005
+        checks_faults.check_fault_drift,    # GL006
+        checks_io.check_durability,         # GL007
+    ]
+
+
+def run_checks(project: Project, select=None, ignore=None) -> List[Finding]:
+    findings: List[Finding] = []
+    for f in project.files:
+        if f.error is not None:
+            findings.append(Finding("GL000", f.path, 1,
+                                    f"syntax error: {f.error}",
+                                    f"{f.path}::syntax"))
+    for check in all_checks():
+        cid = check.check_id
+        if select and cid not in select:
+            continue
+        if ignore and cid in ignore:
+            continue
+        findings.extend(check(project))
+    findings.sort(key=lambda x: (x.path, x.line, x.check, x.ident))
+    return findings
+
+
+def apply_suppressions(project: Project, findings: List[Finding],
+                       baseline: List[BaselineEntry]) -> List[Finding]:
+    """Mark findings suppressed by pragmas or baseline entries; append
+    GL000 findings for reasonless pragmas.  Returns the full annotated
+    list — callers filter on `.suppressed`."""
+    by_path = {f.path: f for f in project.files}
+    extra: List[Finding] = []
+    seen_bad_pragma = set()
+    for fnd in findings:
+        lf = by_path.get(fnd.path)
+        if lf is None:
+            continue
+        # A pragma applies on the offending line itself or anywhere in
+        # the contiguous comment block directly above it (justifications
+        # are encouraged to wrap).
+        lines = lf.source.splitlines()
+        candidates = [fnd.line]
+        ln = fnd.line - 1
+        while ln >= 1 and ln <= len(lines) and \
+                lines[ln - 1].lstrip().startswith("#"):
+            candidates.append(ln)
+            ln -= 1
+        for line in candidates:
+            prag = lf.pragmas.get(line)
+            if prag is None:
+                continue
+            codes, reason = prag
+            if fnd.check not in codes:
+                continue
+            if not reason:
+                if (fnd.path, line) not in seen_bad_pragma:
+                    seen_bad_pragma.add((fnd.path, line))
+                    extra.append(Finding(
+                        "GL000", fnd.path, line,
+                        "pragma without a justification (write "
+                        "`# graftlint: disable=GLxxx -- why`)",
+                        f"{fnd.path}::pragma@{line}"))
+                continue
+            fnd.suppressed = f"pragma: {reason}"
+            break
+        if fnd.suppressed:
+            continue
+        for e in baseline:
+            if e.check == fnd.check and fnmatch.fnmatchcase(fnd.ident,
+                                                            e.ident):
+                e.used = True
+                fnd.suppressed = f"baseline: {e.justification}"
+                break
+    return findings + extra
+
+
+def stale_baseline_findings(baseline: List[BaselineEntry],
+                            path: str) -> List[Finding]:
+    rel = os.path.basename(path)
+    return [Finding("GL000", rel, 1,
+                    f"stale baseline entry: {e.check} {e.ident!r} "
+                    "matched nothing (delete it)",
+                    f"{rel}::stale::{e.check}::{e.ident}")
+            for e in baseline if not e.used]

@@ -1,0 +1,172 @@
+"""The EXAML_* environment-variable registry (GL004's ground truth).
+
+Every env var the runtime reads has exactly one entry here.  Fields:
+
+* ``doc``: "readme" — operator-facing; GL004 verifies the README names
+  it literally (the "Environment flags" table).  "registry" — an
+  internal process contract (parent->child export, test hook); this
+  entry's ``note`` IS the documentation and GL004 requires it
+  non-empty.
+* ``note``: one line on what the flag does / who sets it.
+* ``import_time_ok``: justification string when a module-scope read is
+  intentional (default: forbidden — import-time reads freeze the value
+  before a supervisor/bank parent can pin the child's env).
+
+Adding a read without an entry, deleting the last read of an entry, or
+registering README documentation that is not actually there all fail
+`python -m tools.graftlint`.
+"""
+
+ENV_REGISTRY = {
+    # -- tier escape hatches / degradation ladder ------------------------
+    "EXAML_FAST_TRAVERSAL": {
+        "doc": "readme",
+        "note": "0 pins the scan tier for full traversals (ladder rung)."},
+    "EXAML_PALLAS": {
+        "doc": "readme",
+        "note": "0 disables Mosaic kernels; 'whole' selects the "
+                "whole-traversal Pallas tier."},
+    "EXAML_PALLAS_INTERPRET": {
+        "doc": "readme",
+        "note": "1 runs Pallas kernels in interpret mode (CPU-testable)."},
+    "EXAML_BATCH_SCAN": {
+        "doc": "readme",
+        "note": "0 disables the batched SPR scan tier."},
+    "EXAML_BATCH_THOROUGH": {
+        "doc": "readme",
+        "note": "0 disables the batched thorough-insertion scorer."},
+    "EXAML_BATCH_QUARTETS": {
+        "doc": "readme",
+        "note": "0 disables the batched quartet scorer."},
+    "EXAML_UNIVERSAL": {
+        "doc": "readme",
+        "note": "0 opts out of the universal interpreter; force pins it "
+                "(the supervisor's chunk->scan ladder rung)."},
+    "EXAML_BOUNDED_CHUNKS": {
+        "doc": "readme",
+        "note": "0 restores the legacy unbounded chunk layout."},
+    # -- chunk layout knobs ----------------------------------------------
+    "EXAML_CHUNK_MIN_WIDTH": {
+        "doc": "readme",
+        "note": "bucketed-width ladder floor (default 8)."},
+    "EXAML_CHUNK_CAP": {
+        "doc": "readme",
+        "note": "bucketed-width ladder cap (default 1024)."},
+    "EXAML_CHUNK_TAIL_WIDTH": {
+        "doc": "readme",
+        "note": "scanned-tail normalization width."},
+    # -- numerics ---------------------------------------------------------
+    "EXAML_CLV_DTYPE": {
+        "doc": "readme",
+        "note": "CLV storage dtype (f64 default; bf16 opt-in tier)."},
+    "EXAML_DOT_PRECISION": {
+        "doc": "readme",
+        "note": "jax dot precision for the likelihood contractions."},
+    "EXAML_PSR_REFINE": {
+        "doc": "readme",
+        "note": "0 restores exact reference PSR categorization."},
+    # -- compile cache / banking ------------------------------------------
+    "EXAML_COMPILE_CACHE": {
+        "doc": "readme",
+        "note": "persistent compile-cache path; 0 disables."},
+    "EXAML_COMPILE_TIMEOUT": {
+        "doc": "readme",
+        "note": "per-family compile deadline (bank workers AND the "
+                "in-process watchdog; --compile-timeout exports it)."},
+    "EXAML_HOST_FINGERPRINT": {
+        "doc": "readme",
+        "note": "overrides the CPU-feature fingerprint keying the "
+                "persistent cache (cross-host SIGILL guard)."},
+    "EXAML_BANK_WORKERS": {
+        "doc": "readme",
+        "note": "parallel bank compile-worker count."},
+    "EXAML_BANK_TEST_HANG": {
+        "doc": "registry",
+        "note": "test hook: bank worker hangs on the named family "
+                "(tests/test_bank.py forced-hang e2e)."},
+    # -- observability -----------------------------------------------------
+    "EXAML_TRACE_DIR": {
+        "doc": "readme",
+        "note": "enables the Perfetto span tracer (--trace-events)."},
+    "EXAML_LEDGER_DIR": {
+        "doc": "readme",
+        "note": "enables the run ledger in subprocesses (--ledger "
+                "exports it to bank workers and gang ranks)."},
+    "EXAML_METRICS_FLUSH_S": {
+        "doc": "readme",
+        "note": "periodic --metrics flush cadence (chaos tests pin 0)."},
+    "EXAML_LAUNCH_LATENCY_S": {
+        "doc": "readme",
+        "note": "launch-latency floor for the dispatch-bound regime "
+                "classifier (default 45 us)."},
+    "EXAML_TRAFFIC_WINDOW_DISPATCHES": {
+        "doc": "readme",
+        "note": "min blocking dispatches per achieved-GB/s window."},
+    "EXAML_TRAFFIC_WINDOW_WALL_S": {
+        "doc": "readme",
+        "note": "min wall seconds per achieved-GB/s window."},
+    "EXAML_PEAK_FLOPS": {
+        "doc": "readme",
+        "note": "peak-FLOPs denominator override for bench efficiency "
+                "rows."},
+    # -- resilience / gang process contract --------------------------------
+    "EXAML_FAULTS": {
+        "doc": "readme",
+        "note": "armed fault-injection specs (--inject-fault appends)."},
+    "EXAML_HEARTBEAT_FILE": {
+        "doc": "readme",
+        "note": "heartbeat publish path (supervisor exports it to the "
+                "child; rank files add .p<k>)."},
+    "EXAML_PROCID": {
+        "doc": "readme",
+        "note": "gang rank of this process (supervisor/launch export)."},
+    "EXAML_GANG_RANKS": {
+        "doc": "readme",
+        "note": "gang world size (supervisor/launch export)."},
+    "EXAML_RESTART_COUNT": {
+        "doc": "registry",
+        "note": "supervisor attempt number exported to retries; gates "
+                "attempt-scoped fault specs and backoff jitter."},
+    "EXAML_FLEET_HANG_ATTEMPTS": {
+        "doc": "readme",
+        "note": "job-stuck evidence ('id=n,id=n') the supervisor "
+                "exports so a resumed fleet driver quarantines repeat "
+                "hang offenders."},
+    # -- fleet tier --------------------------------------------------------
+    "EXAML_FLEET_UNIVERSAL": {
+        "doc": "readme",
+        "note": "1/0 forces/disables universal-interpreter routing for "
+                "fleet jobs (default: on for --serve only)."},
+    "EXAML_FLEET_SPECIALIZE_AFTER": {
+        "doc": "readme",
+        "note": "promote a recurring novel profile to the specialized "
+                "batched program after K jobs."},
+    # -- bench harness -----------------------------------------------------
+    "EXAML_BENCH_T0": {
+        "doc": "registry",
+        "note": "bench budget epoch: children inherit the original "
+                "process's start time so spent wall counts against the "
+                "window budget."},
+    "EXAML_BENCH_BUDGET_S": {
+        "doc": "registry",
+        "note": "bench wall budget in seconds (driver-set)."},
+    "EXAML_BENCH_IGNORE_BANK": {
+        "doc": "readme",
+        "note": "1 runs bench stages even for bank-degraded families."},
+    "EXAML_BENCH_LARGE": {
+        "doc": "registry",
+        "note": "1 adds the large synthetic configs to the bench plan."},
+    "EXAML_BENCH_STRIP_PYTHONPATH": {
+        "doc": "registry",
+        "note": "1 strips PYTHONPATH from bench worker children "
+                "(hermetic-subprocess debugging aid)."},
+    # -- tools -------------------------------------------------------------
+    "EXAML_CHIP_PROBE_CMD": {
+        "doc": "registry",
+        "note": "test hook: overrides the chip-probe child command to "
+                "exercise no-answer/hang verdicts without hardware."},
+    "EXAML_DEBUG_MODOPT": {
+        "doc": "registry",
+        "note": "1 prints per-round model-optimizer traces (dev aid; "
+                "tests/test_reference_parity.py uses it)."},
+}

@@ -33,9 +33,10 @@ from examl_tpu.obs import ledger as _ledger      # noqa: E402
 from examl_tpu.obs import traffic as _traffic    # noqa: E402
 
 # Timers whose quantiles the report always surfaces when present
-# (ISSUE: dispatch, host_schedule, compile families, CLI phases).
-_KEY_TIMER_PREFIXES = ("dispatch", "host_schedule", "bench.dispatch",
-                       "bench.evaluate", "bench.newton_branch",
+# (ISSUE: dispatch, host_schedule, compile families, CLI phases, the
+# bench/perf-lab stopwatches and the bank compile/warm phases).
+_KEY_TIMER_PREFIXES = ("dispatch", "host_schedule", "bench.",
+                       "perf_lab.", "bank.compile.", "bank.warm.",
                        "engine.compile_seconds.", "phase.")
 
 
@@ -198,6 +199,46 @@ def render_fleet(out, snap: dict, events: list) -> None:
             + "  ".join(f"{k}={v}" for k, v in sorted(jc.items()) if v))
 
 
+def render_bank(out, snap: dict) -> None:
+    """AOT program-bank evidence: how many families were enumerated,
+    compiled where, degraded or skipped.  A chip round reads this next
+    to `engine.first_calls.*` to confirm the search phase ran with zero
+    unplanned first-call compiles."""
+    c = snap.get("counters") or {}
+    rows = [(label, int(c[k]))
+            for label, k in (("families enumerated", "bank.families"),
+                             ("banked (compiled)", "bank.banked"),
+                             ("skipped (already cached)", "bank.skipped"),
+                             ("compile timeouts", "bank.timeouts"),
+                             ("worker errors", "bank.errors"),
+                             ("worker wedges", "bank.worker_wedges"),
+                             ("degraded to fallback env", "bank.fallbacks"),
+                             ("cache disabled (no_cache)", "bank.no_cache"),
+                             ("sharded in-process residual",
+                              "bank.sharded_residual_families"),
+                             ("warm-phase errors", "bank.warm_errors"))
+            if c.get(k)]
+    if not rows:
+        return
+    out("")
+    out("Program bank (AOT banking phase):")
+    for label, v in rows:
+        out(f"  {label:28s} {v:,d}")
+    if c.get("bank.wall_seconds"):
+        out(f"  {'bank wall':28s} {c['bank.wall_seconds']:.2f}s")
+    fc = [(label, int(c[k]))
+          for label, k in (("banked", "engine.first_calls.banked"),
+                           ("unbanked", "engine.first_calls.unbanked"),
+                           ("degraded in-process",
+                            "engine.first_calls.degraded_inprocess"),
+                           ("sharded in-process",
+                            "engine.first_calls.inprocess_sharded"))
+          if c.get(k)]
+    if fc:
+        out("  first calls                "
+            + "  ".join(f"{label}={v}" for label, v in fc))
+
+
 def render_counters(out, snap: dict) -> None:
     c = snap.get("counters") or {}
     picks = [
@@ -208,8 +249,16 @@ def render_counters(out, snap: dict) -> None:
         ("engine.compile_seconds", "compile seconds"),
         ("engine.pallas_fallbacks", "pallas->XLA fallbacks"),
         ("engine.watchdog_barks", "watchdog barks"),
+        ("search.spr_cycles", "SPR cycles"),
+        ("search.fast_cycles", "fast SPR cycles"),
+        ("search.thorough_cycles", "thorough SPR cycles"),
+        ("search.scan_dispatches", "batched-scan dispatches"),
+        ("search.scan_candidates", "batched-scan candidates"),
+        ("search.model_opt_rounds", "model-opt rounds"),
         ("checkpoint.gang_publishes", "gang checkpoint publishes"),
         ("checkpoint.partial_cycles_gced", "partial cycles GCed"),
+        ("resilience.heartbeats", "heartbeats published"),
+        ("resilience.preempt_checkpoints", "preempt checkpoints"),
         ("resilience.restarts", "supervisor restarts"),
         ("resilience.heartbeat_stalls", "heartbeat stalls"),
     ]
@@ -315,6 +364,7 @@ def render(metrics: dict, events: list, bench: dict,
     if not rows and not bench:
         render_roofline(out, [], "no artifact")
     render_timers(out, metrics)
+    render_bank(out, metrics)
     render_fleet(out, metrics, events)
     render_counters(out, metrics)
     # Bench artifacts embed the workers' merged registry under
