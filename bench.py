@@ -604,6 +604,28 @@ def _stage_prims(state: _WorkerState) -> dict:
         reps=1, warmup=0, name="bench.newton_branch")
     out["newton_branch_ms"] = round(dt / len(inner) * 1000, 3)
 
+    # Whole-tree gradient pass (ops/gradient.py): ALL 2n-3 branch
+    # derivatives in one dispatch — the row to read NEXT TO
+    # newton_branch_ms (the per-branch cost it replaces), and the
+    # dispatches-per-smoothing-round gauge after one gradient-mode
+    # sweep (the ROADMAP §5 O(n)->O(1) acceptance number).
+    from examl_tpu.optimize import branch as _branch
+    if (_branch.grad_smooth_enabled()
+            and _branch.grad_smooth_ineligible(inst) is None):
+        inst.evaluate(tree, full=True)
+        _branch.tree_gradients(inst, tree)     # warm the grad program
+        dt = obs.time_dispatch(
+            lambda: _branch.tree_gradients(inst, tree),
+            reps=1, warmup=0, name="bench.grad_pass")
+        out["grad_pass_ms"] = round(dt * 1000, 3)
+        _branch.gradient_smooth_tree(inst, tree, 1)
+        snap_g = obs.registry().snapshot_light()["gauges"]
+        out["smooth_dispatches"] = snap_g.get(
+            "engine.dispatches_per_smoothing_round")
+    else:
+        out["grad_pass_ms"] = None
+        out["smooth_dispatches"] = None
+
     from examl_tpu.search import batchscan, spr
     from examl_tpu.tree.topology import hookup
     ctx = spr.SprContext(inst, thorough=False, do_cutoff=False)
@@ -1015,8 +1037,9 @@ def _assemble(results: dict, notes: list, cpu_fallback: bool) -> str:
         doc["pallas_check_error"] = pc["error"]
     # Secondary metrics: keys always present (null when the stage was
     # skipped/hung/failed) so consumers can index them unconditionally.
-    for key in ("evaluate_ms", "newton_branch_ms",
-                "spr_scan_ms_per_node", "spr_scan_candidates"):
+    for key in ("evaluate_ms", "newton_branch_ms", "grad_pass_ms",
+                "smooth_dispatches", "spr_scan_ms_per_node",
+                "spr_scan_candidates"):
         doc[key] = prims.get(key)
     if "error" in prims:
         doc["prims_error"] = prims["error"]

@@ -50,7 +50,7 @@ class PreparedJob:
     immutable fast structure (topology-keyed, reused across cycles),
     and the batch group key."""
 
-    __slots__ = ("tree", "p", "flat", "st", "key", "z")
+    __slots__ = ("tree", "p", "flat", "st", "key", "z", "gs")
 
     def __init__(self, tree, p, flat, st, key, z):
         self.tree = tree
@@ -59,6 +59,8 @@ class PreparedJob:
         self.st = st          # FastStructure (fast mode) or None
         self.key = key        # hashable batch-group key
         self.z = z            # root-branch z [C]
+        self.gs = None        # gradient GradStructure (lazily built,
+                              # reused while the topology signature holds)
 
 
 def batch_eligible(inst) -> Optional[str]:
@@ -125,7 +127,11 @@ class BatchEvaluator:
         else:
             with obs.timer("host_schedule"):
                 st = fastpath.build_structure(flat, self.ntips)
-        return PreparedJob(tree, p, flat, st, ("fast", st.profile), z)
+        pj = PreparedJob(tree, p, flat, st, ("fast", st.profile), z)
+        if prev is not None and prev.gs is not None \
+                and prev.flat.topo_key == flat.topo_key:
+            pj.gs = prev.gs       # gradient plan survives z-only cycles
+        return pj
 
     def _scan_shape(self, flat) -> tuple:
         """The scan tier's compiled [L, W] traversal shape — the batch
@@ -311,6 +317,177 @@ class BatchEvaluator:
                            eng.models, eng.block_part, eng.weights,
                            eng.tips, eng.site_rates)
         return np.asarray(out)
+
+    # -- batched whole-tree gradient smoothing (--fleet-cycles) --------------
+    # The sequential path paid the per-branch Newton loop PER JOB per
+    # cycle; here one vmapped dispatch per engine per sweep runs every
+    # job's post-order traversal, pre-order (outroot) pass and
+    # all-edges derivative contraction at once (ops/gradient.py), and
+    # the host applies the same Rprop-damped batched Newton update the
+    # single-tree gradient smoother uses (optimize/branch.py).
+
+    def _grad_fn(self, eng, profile, steps: int, width: int, chunks: int,
+                 jpad: int):
+        key = ("fleetgrad", profile, bucket_len(steps), next_pow2(width),
+               next_pow2(chunks), jpad, self.C)
+        fn = eng.cache_get(key)
+        if fn is not None:
+            return fn
+
+        def body(clv, scaler, base, lidx, ridx, lcode, rcode, zl, zr,
+                 p_row, q_row, p_g, q_g, tvp, ex_rows, ey_gidx, ez,
+                 dm, block_part, weights, tips):
+            clv, scaler = eng._run_segments_impl(
+                dm, block_part, tips, clv, scaler, profile, base, lidx,
+                ridx, lcode, rcode, zl, zr)
+            return eng._grad_impl(clv, scaler, p_row, q_row, p_g, q_g,
+                                  tvp, ex_rows, ey_gidx, ez, dm,
+                                  block_part, weights, tips, None)
+
+        vb = jax.vmap(body, in_axes=(0,) * 17 + (None,) * 4)
+        return eng.cache_put(key, jax.jit(vb))
+
+    def _grad_batch(self, jobs: List[PreparedJob], jpad: int):
+        """One vmapped gradient dispatch per engine: (d1, d2) [J, E, C]
+        summed across engines."""
+        from examl_tpu.ops import gradient
+        gss = []
+        for j in jobs:
+            if j.gs is None:
+                with obs.timer("host_schedule"):
+                    j.gs = gradient.build_structure(
+                        j.flat, self.engines[0].wave_width)
+            gss.append(j.gs)
+        shapes = {(g.n_steps, g.wave_w, g.n_chunks) for g in gss}
+        assert len(shapes) == 1, f"grad batch mixes shapes {shapes}"
+        steps, width, chunks = shapes.pop()
+        E = gss[0].n_edges
+        J = len(jobs)
+        # Re-read branch vectors THROUGH the tree per sweep: smoothing
+        # mutates z between dispatches, and flat/prep z arrays are
+        # captured copies (the structural halves — st, gs — stay valid
+        # while the topology signature holds).
+        with obs.timer("host_schedule"):
+            for j in jobs:
+                j.flat = j.tree.flat_full_traversal(j.p)
+        d1 = d2 = None
+        for eng in self.engines:
+            with obs.timer("host_schedule"):
+                zs = [fastpath.refresh_z(j.st, j.flat, self.C, eng.dtype)
+                      for j in jobs]
+                dyn = [gradient.grad_arrays(
+                           g, j.flat, np.asarray(j.st.row_of), self.C,
+                           z_slots(j.p.z, self.C))
+                       for g, j in zip(gss, jobs)]
+            fn = self._grad_fn(eng, jobs[0].st.profile, steps, width,
+                               chunks, jpad)
+            clv, scaler = self._batch_arenas(eng, jpad)
+            pq = [(self._gidx_st(j.st, j.p.number),
+                   self._gidx_st(j.st, j.p.back.number)) for j in jobs]
+
+            def stk(xs, dtype=None):
+                return self._pad_stack(
+                    [jnp.asarray(x, dtype) if dtype else jnp.asarray(x)
+                     for x in xs], jpad)
+
+            tvp = kernels.OutrootTraversal(
+                up_row=stk([d[0][0] for d in dyn]),
+                lrow=stk([d[0][1] for d in dyn]),
+                rrow=stk([d[0][2] for d in dyn]),
+                left=stk([d[0][3] for d in dyn]),
+                right=stk([d[0][4] for d in dyn]),
+                zu=stk([d[0][5] for d in dyn], eng.dtype),
+                zl=stk([d[0][6] for d in dyn], eng.dtype),
+                zr=stk([d[0][7] for d in dyn], eng.dtype))
+            obs.inc("engine.dispatch_count")
+            obs.inc("engine.grad_pass_dispatches")
+            with obs.device_span("fleet:grad_smooth",
+                                 args={"jobs": J, "jpad": jpad}):
+                e1, e2 = fn(
+                    clv, scaler,
+                    self._pad_stack([j.st.base for j in jobs], jpad),
+                    self._pad_stack([j.st.lidx for j in jobs], jpad),
+                    self._pad_stack([j.st.ridx for j in jobs], jpad),
+                    self._pad_stack([j.st.lcode for j in jobs], jpad),
+                    self._pad_stack([j.st.rcode for j in jobs], jpad),
+                    self._pad_stack([z[0] for z in zs], jpad),
+                    self._pad_stack([z[1] for z in zs], jpad),
+                    stk([jnp.int32(g.roots[0] - 1) for g in gss]),
+                    stk([jnp.int32(g.roots[1] - 1) for g in gss]),
+                    stk([jnp.int32(self._gidx_st(j.st, g.roots[0]))
+                         for j, g in zip(jobs, gss)]),
+                    stk([jnp.int32(self._gidx_st(j.st, g.roots[1]))
+                         for j, g in zip(jobs, gss)]),
+                    tvp, stk([d[1] for d in dyn]),
+                    stk([d[2] for d in dyn]),
+                    stk([d[3] for d in dyn], eng.dtype),
+                    eng.models, eng.block_part, eng.weights, eng.tips)
+            e1 = np.asarray(e1, dtype=np.float64)[:J, :E]
+            e2 = np.asarray(e2, dtype=np.float64)[:J, :E]
+            d1 = e1 if d1 is None else d1 + e1
+            d2 = e2 if d2 is None else d2 + e2
+        return d1, d2
+
+    def smooth_batch(self, jobs: List[PreparedJob], maxtimes: int) -> bool:
+        """Whole-tree gradient smoothing for one same-profile batch:
+        per sweep ONE vmapped dispatch per engine covers every job's
+        gradient pass, then the batched Rprop-damped Newton update
+        applies to all jobs' branches simultaneously — replacing the
+        per-job sequential Newton loop `--fleet-cycles` used to pay.
+        Returns False when some job's branches still moved at the
+        sweep budget — the caller ACCEPTS that like the per-branch
+        path accepts its own maxtimes exhaustion (counted as
+        fleet.grad_smooth_unconverged); only a raise falls back to
+        the per-job path."""
+        import os as _os
+
+        from examl_tpu.constants import DELTAZ, ZMAX, ZMIN
+        from examl_tpu.optimize.branch import _edge_slots
+        from examl_tpu.ops import gradient
+        assert jobs
+        assert len({j.key for j in jobs}) == 1, \
+            "smooth batch mixes job groups (driver bug)"
+        try:
+            damping = float(_os.environ.get("EXAML_GRAD_DAMPING", "")
+                            or 1.0)
+        except ValueError:
+            damping = 1.0
+        jpad = self._pick_jpad(("fleetgrad",) + tuple(
+            sorted({j.key for j in jobs})), len(jobs))
+        J = len(jobs)
+        slot_lists = [_edge_slots(j.tree, j.flat, j.p) for j in jobs]
+        scale = prev_step = None
+        done = np.zeros(J, dtype=bool)
+        for _ in range(max(1, 4 * maxtimes)):
+            d1, d2 = self._grad_batch(jobs, jpad)      # [J, E, C]
+            z0 = np.clip(np.stack(
+                [[z_slots(s.z, self.C) for s in sl] for sl in slot_lists]),
+                ZMIN, ZMAX)
+            znew = gradient.newton_step(z0, d1, d2)
+            step = np.log(znew) - np.log(z0)
+            if scale is None:
+                scale = np.full_like(step, damping)
+            else:
+                flip = prev_step * step < 0.0
+                scale = np.maximum(
+                    np.where(flip, scale * 0.5,
+                             np.minimum(scale * 1.2, damping)),
+                    1.0 / 64)
+            prev_step = step
+            zapp = np.clip(z0 * np.exp(step * scale), ZMIN, ZMAX)
+            zapp = np.where(done[:, None, None], z0, zapp)
+            moved = np.abs(zapp - z0) > DELTAZ
+            for ji, sl in enumerate(slot_lists):
+                if done[ji]:
+                    continue
+                for i, s in enumerate(sl):
+                    s.z[:] = zapp[ji, i].tolist()
+            done |= ~moved.any(axis=(1, 2))
+            obs.inc("fleet.grad_smooth_sweeps")
+            if done.all():
+                return True
+        obs.inc("fleet.grad_smooth_unconverged")
+        return False
 
     # -- weights-only batch (shared topology) --------------------------------
 

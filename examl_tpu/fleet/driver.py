@@ -572,8 +572,39 @@ class FleetDriver:
         if not later:
             return
         from examl_tpu.constants import SMOOTHINGS
-        from examl_tpu.optimize.branch import smooth_tree
-        for job in later:
+        from examl_tpu.optimize.branch import (grad_smooth_enabled,
+                                               grad_smooth_ineligible,
+                                               smooth_tree)
+        remaining = list(later)
+        if (grad_smooth_enabled() and self.evaluator is not None
+                and self.evaluator.fast
+                and grad_smooth_ineligible(self.inst) is None):
+            # Batched whole-tree gradient smoothing: ONE vmapped
+            # dispatch per engine per sweep covers every job in the
+            # batch (fleet/batch.py smooth_batch) instead of the
+            # per-job per-branch Newton loop.  Jobs whose prepared
+            # state is missing (bisection leaves arriving solo) or
+            # that fail to settle fall through to the per-job path.
+            grouped = [j for j in later if j.job_id in self._prepared
+                       and self._prepared[j.job_id].st is not None]
+            if grouped:
+                preps = [self._prepared[j.job_id] for j in grouped]
+                try:
+                    # Budget exhaustion is accepted like the per-branch
+                    # path accepts its own maxtimes exhaustion; only a
+                    # hard failure re-runs the per-job rung.
+                    self.evaluator.smooth_batch(preps, SMOOTHINGS)
+                    ok = True
+                except Exception as exc:   # noqa: BLE001 — job-level
+                    # fault domain: smoothing failures re-run per job
+                    self.log("fleet: batched gradient smoothing failed "
+                             f"({exc}); smoothing per job")
+                    ok = False
+                if ok:
+                    for job in grouped:
+                        self._smoothed[job.job_id] = job.cycles_done
+                    remaining = [j for j in later if j not in grouped]
+        for job in remaining:
             tree = self._tree_for(job)
             # Smoothing's per-branch Newton steps gather CLVs
             # through the ENGINE's live arena/row map, which the

@@ -97,6 +97,28 @@ def count_tip_children(entries, ntips: int) -> int:
     return n
 
 
+def bytes_per_grad_pass(n_entries: int, n_tip_children: int,
+                        n_edges: int, patterns: int, R: int, K: int,
+                        itemsize: int) -> int:
+    """Closed-form model of one whole-tree gradient dispatch
+    (ops/gradient.py): the PRE-ORDER pass reads one outroot row and
+    two child partials per entry (tip children read 1-byte code rows,
+    like the post-order model) and writes two outroot rows; the
+    EDGE-DERIVATIVE contraction then reads one outroot row and one
+    down partial per edge (d1/d2 outputs are O(edges) scalars —
+    noise).  Shares the post-order model's per-row cost so the "grad"
+    tier's achieved-GB/s gauge is comparable with the traversal
+    tiers'."""
+    clv_row = patterns * R * K * itemsize
+    sc_row = patterns * 4
+    inner_children = 2 * n_entries - n_tip_children
+    pre = ((n_entries + 2 * n_entries) * clv_row      # up reads + writes
+           + inner_children * (clv_row + sc_row)      # child CLV reads
+           + n_tip_children * patterns)               # child code reads
+    edges = n_edges * (2 * clv_row + sc_row)
+    return pre + edges
+
+
 def bytes_per_traversal(entries, ntips: int, patterns: int, R: int,
                         K: int, itemsize: int) -> int:
     """Entry-list form — the exact historical bench.py signature, now a

@@ -82,6 +82,9 @@ FALLBACK_ENV = {
     "whole": (("EXAML_PALLAS", "0"),
               "whole-traversal Pallas kernel disabled (XLA fast path "
               "or scan tier)"),
+    "grad": (("EXAML_GRAD_SMOOTH", "0"),
+             "whole-tree gradient smoothing disabled (per-branch "
+             "Newton path)"),
     "scan": (("EXAML_BATCH_SCAN", "0"),
              "sequential SPR scans (per-candidate dispatches)"),
     "thscan": (("EXAML_BATCH_THOROUGH", "0"),
@@ -194,6 +197,11 @@ def enumerate_families(mode: str = "d", psr: bool = False,
         fams.append("fast")
         if e.get("EXAML_PALLAS") == "whole":
             fams.append("whole")
+    if not save_memory and e.get("EXAML_GRAD_SMOOTH") != "0":
+        # Whole-tree gradient smoothing (ops/gradient.py): one program
+        # per bucketed (steps, width, chunks) shape — like the scan
+        # tier, a small closed family whose key is shape, not topology.
+        fams.append("grad")
     if psr:
         fams.append("rate_scan")
     if mode in ("d", "o") and e.get("EXAML_BATCH_SCAN") != "0":
@@ -281,6 +289,12 @@ def _applicability(inst, family: str) -> Optional[str]:
     if family == "whole":
         if not any(e.pallas_whole for e in engines):
             return "whole-traversal kernel needs EXAML_PALLAS=whole on TPU"
+        return None
+    if family == "grad":
+        if inst.save_memory:
+            return "whole-tree gradients need the dense CLV arena (-S)"
+        if any(e.sharding is not None for e in inst.engines.values()):
+            return "whole-tree gradient smoothing is single-process"
         return None
     if family == "rate_scan":
         return None if inst.psr else "GAMMA run has no rate scan"
@@ -392,6 +406,14 @@ def warm_family(inst, tree, family: str) -> None:
         finally:
             for e, v in zip(engines, prior):
                 e.universal_force = v
+        return
+    if family == "grad":
+        # The whole-tree gradient pass over the run's own tree: the
+        # bucketed (steps, width, chunks) shapes this compiles are the
+        # exact shapes every smoothing sweep of the search reuses.
+        from examl_tpu.optimize.branch import tree_gradients
+        inst.evaluate(tree, full=True)
+        tree_gradients(inst, tree)
         return
     if family == "rate_scan":
         from examl_tpu.optimize.psr import MIN_RATE

@@ -208,6 +208,14 @@ class LikelihoodEngine:
         # only memory hygiene.
         self._universal_tables = OrderedDict()
         self._universal_tables_cap = 8
+        # Whole-tree gradient plans (ops/gradient.py): the reversed
+        # wave packing + edge table, a function of topology + root
+        # edge only — keyed like the structure cache by topology
+        # signature (content-keyed: staleness impossible, eviction is
+        # hygiene).  z values and CLV gather indices refresh per
+        # dispatch.
+        self._grad_structs = OrderedDict()
+        self._grad_structs_cap = 8
         self.sharding = sharding
         self.pallas_interpret = _pos.environ.get(
             "EXAML_PALLAS_INTERPRET", "") == "1"
@@ -1131,6 +1139,7 @@ class LikelihoodEngine:
             obs.inc("engine.sched_cache.invalidate")
             self._sched_cache.clear()
         self._universal_tables.clear()
+        self._grad_structs.clear()
 
     def _fast_structure(self, flat):
         from examl_tpu.ops import fastpath
@@ -2017,5 +2026,124 @@ class LikelihoodEngine:
             d1, d2 = self._jit_derivs(st, zv, self.models, self.block_part,
                                       self.weights, self.site_rates)
         return np.asarray(d1), np.asarray(d2)
+
+    # -- whole-tree analytic gradients (ops/gradient.py) --------------------
+    # One pre-order (outroot) pass over the reversed wave schedule plus
+    # one batched edge-derivative contraction gives (d1, d2) for ALL
+    # 2n-3 branches in a single dispatch — the O(n)->O(1) replacement
+    # for the per-branch sumtable+Newton round trips that dominate
+    # smoothTree/treeEvaluate on large trees (ROADMAP §5).
+
+    def grad_eligible(self) -> bool:
+        """The gradient pass runs on the dense CLV arena (any tier's
+        post-order output); -S SEV pools keep the per-branch path."""
+        return not self.save_memory
+
+    def _grad_structure(self, flat):
+        from examl_tpu.ops import gradient
+        gs = self._grad_structs.get(flat.topo_key)
+        if gs is not None:
+            self._grad_structs.move_to_end(flat.topo_key)
+            return gs
+        gs = gradient.build_structure(flat, self.wave_width)
+        self._grad_structs[flat.topo_key] = gs
+        while len(self._grad_structs) > self._grad_structs_cap:
+            self._grad_structs.popitem(last=False)
+        return gs
+
+    def _grad_impl(self, clv, scaler, p_row, q_row, p_gidx, q_gidx, tvp,
+                   ex_rows, ey_gidx, ez, dm, block_part, weights, tips,
+                   sr):
+        """Traced gradient program: outroot-arena init at the root edge
+        (out(p) = D(q), out(q) = D(p)), the reverse-wave sibling-combine
+        pass, then the chunked all-edges derivative contraction.  The
+        outroot arena lives only inside this program; clv/scaler are
+        read-only (NOT donated — the engine keeps serving them)."""
+        from examl_tpu.ops import gradient
+        out = jnp.zeros((2 * self.ntips - 1, self.B, self.lane, self.R,
+                         self.K), dtype=self.dtype)
+        dq, _ = kernels.gather_child(tips, clv, scaler, q_gidx, self.ntips)
+        dp, _ = kernels.gather_child(tips, clv, scaler, p_gidx, self.ntips)
+        out = out.at[p_row].set(dq.astype(out.dtype))
+        out = out.at[q_row].set(dp.astype(out.dtype))
+        out = kernels.outroot_pass(dm, block_part, tips, clv, scaler, out,
+                                   tvp, self.scale_exp, self.ntips, sr)
+        return gradient.edge_gradients(
+            dm, block_part, weights, tips, clv, scaler, out, ex_rows,
+            ey_gidx, ez, self.num_branch_slots, self.ntips, sr)
+
+    def whole_tree_gradients(self, flat, root_z):
+        """(d1, d2) [E, C]: lnL gradient and curvature w.r.t. lz = log z
+        for every branch of the FULL traversal `flat`, in ONE dispatch.
+
+        Edge order: edge 0 is the traversal's root edge; edges 1+2i /
+        2+2i are entry i's left / right child branches (flat order).
+        PRECONDITION: the CLV arena is current for `flat` (a
+        `run_traversal(flat, full=True)` — any tier — just ran);
+        `root_z` is the root edge's branch vector.
+
+        The jit key is shape-only — ("grad", steps, width, chunks), all
+        bucketed — so like the scan tier this is a tiny closed program
+        family and topology ships as runtime data.
+        """
+        from examl_tpu.ops import gradient
+        from examl_tpu.ops.kernels import OutrootTraversal
+        if not self.grad_eligible():
+            raise RuntimeError("whole-tree gradients need the dense CLV "
+                               "arena (-S SEV pools keep the per-branch "
+                               "Newton path)")
+        gs = self._grad_structure(flat)
+        with obs.timer("host_schedule"):
+            pre, ex_rows, ey_gidx, ez = gradient.grad_arrays(
+                gs, flat, self.row_map, self.num_branch_slots, root_z)
+        key = ("grad", _bucket_len(gs.n_steps), _next_pow2(gs.wave_w),
+               _next_pow2(gs.n_chunks))
+        fn = self.cache_get(key)
+        if fn is None:
+            fn = self.cache_put(key, jax.jit(self._grad_impl))
+        obs.inc("engine.dispatch_count")
+        obs.inc("engine.grad_pass_dispatches")
+        itemsize = np.dtype(self.storage_dtype).itemsize
+        tip_children = int((np.asarray(flat.left) <= self.ntips).sum()
+                           + (np.asarray(flat.right) <= self.ntips).sum())
+        nbytes = _traffic.bytes_per_grad_pass(
+            gs.n, tip_children, gs.n_edges, self._patterns_true, self.R,
+            self.K, itemsize)
+        compiles0 = obs.registry().counter("engine.compile_count")
+        p, q = gs.roots
+        up_row, lrow, rrow, lg, rg, zu, zl, zr = pre
+        tvp = OutrootTraversal(
+            up_row=jnp.asarray(up_row), lrow=jnp.asarray(lrow),
+            rrow=jnp.asarray(rrow), left=jnp.asarray(lg),
+            right=jnp.asarray(rg),
+            zu=jnp.asarray(zu, dtype=self.dtype),
+            zl=jnp.asarray(zl, dtype=self.dtype),
+            zr=jnp.asarray(zr, dtype=self.dtype))
+        t0 = time.perf_counter()
+        with obs.device_span("engine:grad_pass",
+                             args={"edges": gs.n_edges,
+                                   "steps": gs.n_steps}):
+            d1, d2 = fn(self.clv, self.scaler,
+                        jnp.int32(p - 1), jnp.int32(q - 1),
+                        jnp.int32(self._gidx(p)), jnp.int32(self._gidx(q)),
+                        tvp, jnp.asarray(ex_rows), jnp.asarray(ey_gidx),
+                        jnp.asarray(ez, dtype=self.dtype), self.models,
+                        self.block_part, self.weights, self.tips,
+                        self.site_rates)
+            # Blocking by contract: the host-side batched Newton update
+            # consumes d1/d2 — this sync IS the gradient measurement
+            # (the registered seam, like the trav-eval family).
+            d1 = np.asarray(d1, dtype=np.float64)
+            d2 = np.asarray(d2, dtype=np.float64)
+        dt = time.perf_counter() - t0
+        obs.observe("engine.grad_pass", dt)
+        # The gradient program is one device op whose scan walks
+        # n_steps + n_chunks dependent steps — the launch-floor term.
+        self._last_dispatch_ops = gs.n_steps + gs.n_chunks
+        self._record_traffic(
+            nbytes, "grad", wall_s=dt,
+            window=(obs.registry().counter("engine.compile_count")
+                    == compiles0))
+        return d1[:gs.n_edges], d2[:gs.n_edges]
 
 

@@ -334,6 +334,104 @@ def traverse_pooled(models: DeviceModels, block_part: jax.Array,
     return pool, scaler
 
 
+class OutrootTraversal(NamedTuple):
+    """Fixed-size padded PRE-ORDER traversal descriptor (host-built by
+    ops/gradient.py): the post-order wave schedule executed in REVERSE
+    wave order, each entry emitting the root-directed (outroot)
+    partials of its two children.  `up_row` indexes the outroot arena
+    (node number - 1; every node has a row, the last row is scratch);
+    `left`/`right` are gather indices against the post-order CLV arena
+    (tips by code slot, inner by ntips + arena row, exactly
+    `gather_child`'s convention).  `zu` is the branch ABOVE the entry's
+    parent node (the root edge z for the two root-adjacent entries).
+    Padding entries read and write the scratch row."""
+    up_row: jax.Array       # [L, W] int32 outroot-arena row of the parent
+    lrow: jax.Array         # [L, W] int32 outroot row written for left
+    rrow: jax.Array         # [L, W] int32 outroot row written for right
+    left: jax.Array         # [L, W] int32 gather index of left child
+    right: jax.Array        # [L, W] int32 gather index of right child
+    zu: jax.Array           # [L, W, C] branch above the parent
+    zl: jax.Array           # [L, W, C]
+    zr: jax.Array           # [L, W, C]
+
+
+def outroot_wave(models: DeviceModels, block_part: jax.Array,
+                 xu: jax.Array, xl: jax.Array, xr: jax.Array,
+                 zu: jax.Array, zl: jax.Array, zr: jax.Array,
+                 scale_exp: int, site_rates=None):
+    """Sibling-combine for one wave of W pre-order entries.
+
+    xu: the parent's outroot partial [W, B, lane, R, K] (complement of
+    the parent's subtree, located at the grandparent's end of the
+    parent's upper branch); xl, xr: the children's post-order CLVs.
+    Returns (out_l, out_r): out_l = (P(zu) xu) * (P(zr) xr) is the
+    complement of the LEFT child's subtree located at the parent — the
+    mirror image of `newview_wave`'s child combine, with the sibling's
+    down partial standing in for one child and the transported outroot
+    partial for the other (Ji et al. 2303.04390's pre-order recursion;
+    BEAGLE 4.1's edge-derivative pre-order buffers).
+
+    Rescaling applies the same threshold/multiplier discipline as
+    `newview_wave` but tracks NO counts: every edge-gradient consumer
+    is a dsite/lsite ratio in which per-site scale factors cancel
+    exactly (`nr_derivatives` never reads scalers), so keeping the
+    values in floating range is sufficient.
+    """
+    if site_rates is None:
+        pu = p_matrices_wave(models, zu)[:, block_part]     # [W, B, R, K, K]
+        pl = p_matrices_wave(models, zl)[:, block_part]
+        pr = p_matrices_wave(models, zr)[:, block_part]
+        yu = einsum("wbrak,wblrk->wblra", pu, xu)
+        yl = einsum("wbrak,wblrk->wblra", pl, xl)
+        yr = einsum("wbrak,wblrk->wblra", pr, xr)
+    else:
+        du = jax.vmap(lambda zz: psr_decay(models, block_part, site_rates,
+                                           zz))(zu)          # [W, B, l, R, K]
+        dl = jax.vmap(lambda zz: psr_decay(models, block_part, site_rates,
+                                           zz))(zl)
+        dr = jax.vmap(lambda zz: psr_decay(models, block_part, site_rates,
+                                           zz))(zr)
+        yu = apply_p_factorized(models, block_part, du, xu)
+        yl = apply_p_factorized(models, block_part, dl, xl)
+        yr = apply_p_factorized(models, block_part, dr, xr)
+    minlik, two_e, _ = scale_constants(yu.dtype, scale_exp)
+
+    def rescale(v):
+        vmax = jnp.max(jnp.abs(v), axis=(3, 4))             # [W, B, lane]
+        return jnp.where((vmax < minlik)[:, :, :, None, None], v * two_e, v)
+
+    return rescale(yu * yr), rescale(yu * yl)
+
+
+def outroot_pass(models: DeviceModels, block_part: jax.Array,
+                 tips: TipState, clv: jax.Array, scaler: jax.Array,
+                 out: jax.Array, tv: OutrootTraversal, scale_exp: int,
+                 ntips: int, site_rates=None) -> jax.Array:
+    """Execute a pre-order traversal: lax.scan over reversed waves, each
+    wave a batched `outroot_wave` over its independent entries — the
+    exact mirror of `traverse`, filling the outroot arena `out`
+    [2*ntips-1, B, lane, R, K] (rows by node number - 1, last row
+    scratch) instead of the CLV arena.  `out` must arrive with the two
+    root rows initialized (out[p-1] = D(q), out[q-1] = D(p)); `clv` and
+    `scaler` are read-only (the post-order partials)."""
+    def body(carry, e):
+        out = carry
+        up_row, lrow, rrow, left, right, zu, zl, zr = e
+        xu = out[up_row]
+        xl, _ = gather_child(tips, clv, scaler, left, ntips)
+        xr, _ = gather_child(tips, clv, scaler, right, ntips)
+        ol, orr = outroot_wave(models, block_part, xu, xl, xr,
+                               zu, zl, zr, scale_exp, site_rates)
+        out = out.at[lrow].set(ol.astype(out.dtype), unique_indices=False)
+        out = out.at[rrow].set(orr.astype(out.dtype), unique_indices=False)
+        return out, None
+
+    out, _ = jax.lax.scan(
+        body, out, (tv.up_row, tv.lrow, tv.rrow, tv.left, tv.right,
+                    tv.zu, tv.zl, tv.zr))
+    return out
+
+
 def site_likelihoods(models: DeviceModels, block_part: jax.Array,
                      xp: jax.Array, xq: jax.Array, z: jax.Array,
                      site_rates=None):

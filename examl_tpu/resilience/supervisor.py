@@ -71,7 +71,7 @@ DEGRADE_LADDER = (
     {"EXAML_PALLAS": "0", "EXAML_UNIVERSAL": "force"},
     {"EXAML_PALLAS": "0", "EXAML_FAST_TRAVERSAL": "0",
      "EXAML_UNIVERSAL": "0", "EXAML_BATCH_SCAN": "0",
-     "EXAML_BATCH_THOROUGH": "0"},
+     "EXAML_BATCH_THOROUGH": "0", "EXAML_GRAD_SMOOTH": "0"},
 )
 
 DEFAULT_RETRIES = 3

@@ -198,6 +198,48 @@ def main(argv=None) -> int:
                 failures.append(f"multi-start {jid}: fleet {row['lnl']} "
                                 f"vs single {lnl}")
 
+    # --fleet-cycles follow-through: cycle >= 2 smoothing now routes
+    # through the vmapped batched whole-tree gradient step (ONE
+    # dispatch per engine per sweep for the whole batch, fleet/batch.py
+    # smooth_batch) instead of the per-job per-branch Newton loop.
+    # Assert the sweeps ran and that each job's final lnL matches the
+    # sequential path (same gradient smoother, one tree at a time).
+    grad_metrics = os.path.join(workdir, "metrics_grad.json")
+    grad_sweeps = 0
+    grad_parity = 0.0
+    rc = cli_main(["-s", bf, "-n", "FSMOKE_G", "-N", "4",
+                   "-p", str(args.seed), "-w", workdir,
+                   "--fleet-cycles", "2", "--metrics", grad_metrics])
+    if rc != 0:
+        failures.append(f"--fleet-cycles CLI run rc={rc}")
+    else:
+        with open(grad_metrics) as f:
+            gc = (json.load(f).get("counters") or {})
+        grad_sweeps = int(gc.get("fleet.grad_smooth_sweeps", 0))
+        if os.environ.get("EXAML_GRAD_SMOOTH", "") != "0":
+            if not grad_sweeps:
+                failures.append("--fleet-cycles 2 ran no batched "
+                                "gradient smoothing sweeps")
+            if not gc.get("engine.grad_pass_dispatches"):
+                failures.append("no whole-tree gradient dispatches in "
+                                "--fleet-cycles run")
+        gtab = read_fleet_table(
+            os.path.join(workdir, "ExaML_fleet.FSMOKE_G"))
+        from examl_tpu.constants import SMOOTHINGS
+        from examl_tpu.optimize.branch import smooth_tree
+        for eng in inst.engines.values():       # true pattern weights
+            eng.weights = jnp.asarray(np.asarray(
+                eng.bucket.weights.reshape(eng.B, eng.lane)), eng.dtype)
+        for jid, jrow in gtab.items():
+            t = inst.random_tree(seed=jrow["seed"])
+            inst.evaluate(t, full=True)
+            smooth_tree(inst, t, SMOOTHINGS)
+            lnl = inst.evaluate(t, full=True)
+            grad_parity = max(grad_parity, abs(lnl - jrow["lnl"]))
+        if grad_parity > 1e-4:
+            failures.append("batched gradient smoothing diverges from "
+                            f"the sequential path: {grad_parity}")
+
     row = {
         "bench": "fleet",
         "scenario": "bootstrap",
@@ -213,6 +255,8 @@ def main(argv=None) -> int:
         "batches": counters.get("fleet.batches"),
         "jobs_done": len(done),
         "parity_max_abs": max_abs,
+        "grad_smooth_sweeps": grad_sweeps,
+        "grad_parity_max_abs": grad_parity,
     }
     out_path = args.out or os.path.join(workdir, "FLEET_BENCH.json")
     with open(out_path, "w") as f:

@@ -53,6 +53,12 @@ SYNC_SEAMS = (
     # the candidate lnls ARE the selection input on the host.
     ("examl_tpu/ops/engine.py", "batched_scan"),
     ("examl_tpu/ops/engine.py", "batched_thorough"),
+    # Whole-tree gradient pass: d1/d2 for all branches feed the
+    # host-side batched Newton update — one sync per smoothing sweep
+    # (vs one per BRANCH on the per-branch path), and its blocking
+    # wall is the "grad" tier's achieved-GB/s measurement.
+    ("examl_tpu/ops/engine.py", "whole_tree_gradients"),
+    ("examl_tpu/fleet/batch.py", "_grad_batch"),
     # Fleet batched evaluation: per-job host lnL rows at the batch
     # boundary feed the results table and the fsync'd journal.
     ("examl_tpu/fleet/batch.py", "_eval_fast"),

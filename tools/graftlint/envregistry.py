@@ -45,6 +45,14 @@ ENV_REGISTRY = {
     "EXAML_BOUNDED_CHUNKS": {
         "doc": "readme",
         "note": "0 restores the legacy unbounded chunk layout."},
+    "EXAML_GRAD_SMOOTH": {
+        "doc": "readme",
+        "note": "0 restores the per-branch Newton smoothing path "
+                "(whole-tree analytic gradients otherwise)."},
+    "EXAML_GRAD_DAMPING": {
+        "doc": "readme",
+        "note": "base step scale for gradient-mode branch smoothing "
+                "(default 1.0; the per-branch Rprop ladder caps at it)."},
     # -- chunk layout knobs ----------------------------------------------
     "EXAML_CHUNK_MIN_WIDTH": {
         "doc": "readme",

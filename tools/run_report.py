@@ -37,7 +37,8 @@ from examl_tpu.obs import traffic as _traffic    # noqa: E402
 # bench/perf-lab stopwatches and the bank compile/warm phases).
 _KEY_TIMER_PREFIXES = ("dispatch", "host_schedule", "bench.",
                        "perf_lab.", "bank.compile.", "bank.warm.",
-                       "engine.compile_seconds.", "phase.")
+                       "engine.compile_seconds.", "engine.grad_pass",
+                       "phase.")
 
 
 def _fmt_s(v) -> str:
@@ -244,6 +245,14 @@ def render_counters(out, snap: dict) -> None:
     picks = [
         ("engine.dispatch_count", "device dispatches"),
         ("engine.traversal_entries", "traversal entries"),
+        ("engine.grad_pass_dispatches", "whole-tree gradient passes"),
+        ("optimize.grad_smooth_sweeps", "gradient smoothing sweeps"),
+        ("optimize.grad_smooth_fallbacks", "gradient->NR fallbacks"),
+        ("optimize.grad_smooth_unconverged",
+         "gradient sweep budgets exhausted"),
+        ("fleet.grad_smooth_sweeps", "fleet gradient sweeps"),
+        ("fleet.grad_smooth_unconverged",
+         "fleet gradient budgets exhausted"),
         ("engine.traffic_bytes", "modeled HBM bytes"),
         ("engine.compile_count", "compiles"),
         ("engine.compile_seconds", "compile seconds"),
@@ -263,6 +272,12 @@ def render_counters(out, snap: dict) -> None:
         ("resilience.heartbeat_stalls", "heartbeat stalls"),
     ]
     lines = [(label, c[k]) for k, label in picks if c.get(k)]
+    g = snap.get("gauges") or {}
+    if g.get("engine.dispatches_per_smoothing_round") is not None:
+        # The ROADMAP §5 acceptance gauge: O(1) in gradient mode, O(n)
+        # on the per-branch Newton path.
+        lines.append(("dispatches / smoothing round",
+                      g["engine.dispatches_per_smoothing_round"]))
     probes = {k.rsplit(".", 1)[1]: v for k, v in c.items()
               if k.startswith("chip.probe.")}
     faults = {k[len("faults.fired."):]: v for k, v in c.items()

@@ -135,7 +135,10 @@ def render_frame(out, workdir: str, beats: list, metrics_path,
                                  ("rej", "fleet.rejected"),
                                  ("retry", "fleet.job_retries"),
                                  ("uni", "engine.universal_dispatches"),
-                                 ("prof_miss", "fleet.profile_misses"))
+                                 ("prof_miss", "fleet.profile_misses"),
+                                 ("grad", "engine.grad_pass_dispatches"),
+                                 ("grad_sweeps",
+                                  "fleet.grad_smooth_sweeps"))
                 if counters.get(k))
             out(f"  fleet{tag}: "
                 f"queue={int(gauges.get('fleet.queue_depth', 0))}  "
