@@ -118,6 +118,21 @@ def build_argparser() -> argparse.ArgumentParser:
                     help="evaluation cycles per fleet job; cycles "
                          "after the first smooth branch lengths "
                          "before re-scoring (default 1)")
+    ap.add_argument("--fleet-devices", dest="fleet_devices", type=int,
+                    default=1,
+                    help="tree-axis device sharding: cut one batch per "
+                         "local device lane and round-robin the "
+                         "profile groups across them (0 = every local "
+                         "device; default 1 = classic single-lane; a "
+                         "device that fails init degrades the set, "
+                         "never aborts)")
+    ap.add_argument("--fleet-lease-ttl", dest="fleet_lease_ttl",
+                    type=float, default=60.0,
+                    help="leased gang serving (--launch N + a fleet "
+                         "mode): seconds a rank's job lease stays "
+                         "live without renewal; a dead rank's leases "
+                         "expire after this and surviving ranks reap "
+                         "them (default 60)")
     ap.add_argument("--bank", dest="bank", action="store_true",
                     help="ahead-of-time program banking: compile every "
                          "device-program family this run will dispatch "
@@ -544,14 +559,43 @@ def run_fleet(args, inst, files: RunFiles) -> int:
     fault domains (retry/quarantine, fleet/quarantine.py) and a
     durable per-job results journal reconciled at resume."""
     from examl_tpu.fleet import jobs as jobs_mod
+    from examl_tpu.fleet import lease as lease_mod
     from examl_tpu.fleet import quarantine
     from examl_tpu.fleet.driver import FleetDriver
 
-    mgr = _checkpoint_manager(args, keep_last=2)
-    journal = quarantine.ResultsJournal(os.path.join(
-        args.workdir, f"ExaML_fleetJournal.{args.run_id}"))
+    # Leased gang serving (ISSUE 14): under `--launch N` (or the
+    # manually-launched rank contract) every rank runs its OWN driver
+    # against the shared workdir — jobs are held under durable per-rank
+    # leases, results journal per rank, and there are NO coordinated
+    # checkpoints (fleet ranks are deliberately not in lockstep; the
+    # per-job fsync'd journal is the durable record).
+    gang = getattr(args, "_gang", None)
+    rank, world, shared_dir = (gang if gang is not None
+                               else (0, 1, args.workdir))
+    leased = gang is not None
+    board = None
+    peer_journals = None
+    if leased:
+        mgr = None
+        board = lease_mod.LeaseBoard(
+            lease_mod.lease_dir(shared_dir, args.run_id), rank,
+            ttl_s=args.fleet_lease_ttl,
+            attempt=int(os.environ.get("EXAML_RESTART_COUNT", "0") or 0))
+        # Incremental tail reads: the absorb loop polls these journals
+        # for the rank's whole life, so each poll parses only appended
+        # records, not every journal from byte 0.
+        peer_journals = quarantine.JournalTail(shared_dir,
+                                               args.run_id).records
+        files.info(f"fleet: leased serving rank {rank} of {world} "
+                   f"(lease board {board.path}, ttl "
+                   f"{args.fleet_lease_ttl:.0f}s)")
+    else:
+        mgr = _checkpoint_manager(args, keep_last=2)
+    journal = quarantine.ResultsJournal(quarantine.journal_path(
+        shared_dir, args.run_id, rank if leased else None))
     deadletters = quarantine.DeadLetters(os.path.join(
-        args.workdir, f"ExaML_fleetFailed.{args.run_id}"))
+        shared_dir, f"ExaML_fleetFailed.{args.run_id}"
+        + (f".r{rank}" if leased else "")))
     if not args.restart:
         # A FRESH run (no -R) reusing a run id must not inherit an
         # abandoned incarnation's journal/dead letters: `-R` later
@@ -560,7 +604,27 @@ def run_fleet(args, inst, files: RunFiles) -> int:
         # these files are removed so they exist only once this
         # incarnation appends (the supervisor keys its automatic -R on
         # that existence).
-        for stale in (journal.path, deadletters.path):
+        stale_files = [journal.path, deadletters.path]
+        if leased and rank == 0:
+            # The primary also clears records NO rank of this world
+            # will write (so they cannot race a live writer): the
+            # BASE (single-process) journal/dead letters a previous
+            # unleased incarnation left, and rank journals beyond the
+            # current world size.  Peers' own `.r<k>` files are each
+            # rank's own fresh-run cleanup.
+            import glob as _glob
+            for pat in (f"ExaML_fleetJournal.{args.run_id}",
+                        f"ExaML_fleetFailed.{args.run_id}"):
+                stale_files.append(os.path.join(shared_dir, pat))
+                for p in _glob.glob(os.path.join(shared_dir,
+                                                 pat + ".r*")):
+                    try:
+                        r = int(p.rsplit(".r", 1)[1])
+                    except ValueError:
+                        continue
+                    if r >= world:
+                        stale_files.append(p)
+        for stale in stale_files:
             try:
                 os.unlink(stale)
             except OSError:
@@ -575,7 +639,18 @@ def run_fleet(args, inst, files: RunFiles) -> int:
         files.info(f"starting tree lnL {inst.likelihood:.6f}")
         files.log_lnl(inst.likelihood)
     resume = None
-    if args.restart:
+    if args.restart and leased:
+        # Leased ranks resume from the MERGED per-rank journals alone
+        # (no coordinated checkpoints exist on purpose); a restarted
+        # rank with no evidence yet — it died before any rank finished
+        # a job — simply starts serving against the lease board.
+        journal_recs = quarantine.read_all_journals(shared_dir,
+                                                    args.run_id)
+        resume = quarantine.reconcile_extras({}, journal_recs)
+        files.info(f"restart (leased rank {rank}): "
+                   f"{len(journal_recs)} journal record(s) reconciled "
+                   "across ranks")
+    elif args.restart:
         scaffold = (start_tree if start_tree is not None
                     else inst.random_tree(seed=args.seed))
         # GC-ordering contract: the journal is read and reconciled
@@ -624,25 +699,42 @@ def run_fleet(args, inst, files: RunFiles) -> int:
                          cycles=args.fleet_cycles, mgr=mgr,
                          log=files.info, policy=policy,
                          journal=journal, deadletters=deadletters,
-                         route_universal=route_universal)
-    if args.serve:
-        jobs = _serve_loop(args, driver, files, resume)
-    else:
-        if args.bootstrap:
-            jobs = jobs_mod.make_jobs("bootstrap", args.bootstrap,
-                                      args.seed, cycles=1)
-            files.info(f"fleet: {len(jobs)} bootstrap replicates of the "
-                       "starting topology")
-            if args.fleet_cycles > 1:
-                files.info("note: --fleet-cycles applies to tree jobs; "
-                           "bootstrap replicates are weights-only "
-                           "(always 1 cycle)")
+                         route_universal=route_universal,
+                         devices=args.fleet_devices,
+                         leases=board, peer_journals=peer_journals)
+    if board is not None:
+        # Keepalive: a long blocking dispatch (a cold first-call
+        # compile easily outlasts any sane ttl) must not let this
+        # rank's leases expire under it.
+        board.start_keepalive()
+    try:
+        if args.serve:
+            jobs = _serve_loop(args, driver, files, resume)
         else:
-            jobs = jobs_mod.make_jobs("start", args.multi_start,
-                                      args.seed, cycles=args.fleet_cycles)
-            files.info(f"fleet: {len(jobs)} multi-start trees, "
-                       f"{args.fleet_cycles} cycle(s) each")
-        jobs = driver.run(jobs, resume)
+            if args.bootstrap:
+                jobs = jobs_mod.make_jobs("bootstrap", args.bootstrap,
+                                          args.seed, cycles=1)
+                files.info(f"fleet: {len(jobs)} bootstrap replicates "
+                           "of the starting topology")
+                if args.fleet_cycles > 1:
+                    files.info("note: --fleet-cycles applies to tree "
+                               "jobs; bootstrap replicates are "
+                               "weights-only (always 1 cycle)")
+            else:
+                jobs = jobs_mod.make_jobs("start", args.multi_start,
+                                          args.seed,
+                                          cycles=args.fleet_cycles)
+                files.info(f"fleet: {len(jobs)} multi-start trees, "
+                           f"{args.fleet_cycles} cycle(s) each")
+            jobs = driver.run(jobs, resume)
+    finally:
+        if board is not None:
+            # Release whatever this rank still holds (a stop sentinel
+            # with jobs in retry backoff, an exception): leases left
+            # behind would make peers wait out the ttl for jobs nobody
+            # owns.
+            board.close()
+        journal.close()
     return _write_fleet_results(args, inst, files, jobs)
 
 
@@ -990,12 +1082,14 @@ def main(argv=None) -> int:
             ap.error("fleet modes (-b/-N/--serve) replace the -f "
                      "algorithm; they cannot combine with -f q")
         if args.save_memory:
-            ap.error("fleet modes do not support -S yet (the SEV pool "
-                     "holds one arena per instance; batched arenas "
-                     "cannot stack)")
-        if args.launch is not None:
-            ap.error("fleet modes run single-gang: use --supervise for "
-                     "kill/resume supervision instead of --launch")
+            # The one genuinely unsupported combination (ISSUE 14): the
+            # SEV pool holds ONE arena per instance, so per-job arenas
+            # cannot stack along a tree axis and per-device lanes
+            # cannot each own a pool region.
+            ap.error("fleet modes do not support -S (the SEV pool holds "
+                     "one arena per instance; batched/sharded per-job "
+                     "arenas cannot stack — ISSUE 14 keeps this the "
+                     "only unrouted combination)")
         if args.bootstrap and not args.tree_file:
             ap.error("-b bootstrap replicates resample weights on a "
                      "fixed topology: a starting tree (-t) is required")
@@ -1005,13 +1099,37 @@ def main(argv=None) -> int:
             ap.error("--fleet-job-deadline must be >= 0")
         if args.serve_max_pending < 1:
             ap.error("--serve-max-pending must be at least 1")
-        if args.nprocs is not None or args.coordinator is not None:
-            ap.error("fleet modes are single-process (the batched tier "
-                     "stacks per-job arenas on one device set); run "
-                     "one fleet per host instead of --nprocs")
-        # The batched tier owns the whole device: per-job arenas stack
-        # along a leading tree axis instead of sharding one tree's site
-        # axis (exactly BEAGLE's multi-analysis device-sharing trade).
+        if args.fleet_devices < 0:
+            ap.error("--fleet-devices must be >= 0 (0 = all local)")
+        if args.fleet_lease_ttl <= 0:
+            ap.error("--fleet-lease-ttl must be positive")
+        if args.launch is None and (args.nprocs is not None
+                                    or args.coordinator is not None):
+            # Manually-launched multi-rank fleets route into the LEASED
+            # rank contract instead of erroring: fleet ranks are NOT a
+            # lockstep SPMD gang (jobs are independent), so the ranks
+            # never join a collective process group — each becomes an
+            # emulated gang rank leasing jobs from the shared board.
+            if (args.nprocs or 1) > 1 and args.procid is None:
+                # Two ranks silently sharing slot 0 would steal each
+                # other's LIVE leases through the own-rank reclaim
+                # path — the rank id must be explicit.
+                ap.error("fleet ranks never join a collective process "
+                         "group; every rank needs an explicit id: use "
+                         "--nprocs N --procid K per rank (or --launch "
+                         "N, which spawns the ranks itself)")
+            if args.coordinator is not None and args.procid is None:
+                ap.error("fleet ranks never join a collective process "
+                         "group; use --nprocs N --procid K per rank "
+                         "(or --launch N, which spawns the ranks)")
+            # Applied to the environment inside the run (with restore),
+            # so repeated in-process main() calls never leak a rank.
+            args._fleet_rank = (args.procid or 0, args.nprocs or 1)
+            args.nprocs = args.coordinator = args.procid = None
+        # The batched tier owns the whole LOCAL device set: per-job
+        # arenas stack along a leading tree axis and round-robin across
+        # device lanes instead of sharding one tree's site axis
+        # (exactly BEAGLE's multi-analysis device-sharing trade).
         if not getattr(args, "single_device", False):
             args.single_device = True
 
@@ -1069,6 +1187,18 @@ def main(argv=None) -> int:
     prior_ledger_env = os.environ.get(_ledger_mod.ENV_VAR)
     for spec in (args.inject_fault or []):
         _faults.arm(spec)
+    # Manually-launched leased fleet rank (--nprocs/--procid routed at
+    # parse time): publish the rank contract through the same env vars
+    # the gang supervisor exports, restored at exit so in-process
+    # callers (tests) never inherit a rank identity.
+    prior_rank_env = {k: os.environ.get(k)
+                      for k in (_heartbeat.PROCID_VAR,
+                                _heartbeat.GANG_VAR)}
+    if getattr(args, "_fleet_rank", None) is not None:
+        k, n = args._fleet_rank
+        os.environ[_heartbeat.PROCID_VAR] = str(k)
+        if n > 1:
+            os.environ[_heartbeat.GANG_VAR] = str(n)
     # One deadline definition for every compile monitor: the bank
     # workers' hard per-family kill AND the in-process watchdog bark
     # read the same knob (exported so subprocess workers inherit it).
@@ -1192,6 +1322,13 @@ def main(argv=None) -> int:
             os.environ.pop(_ledger_mod.ENV_VAR, None)
         else:
             os.environ[_ledger_mod.ENV_VAR] = prior_ledger_env
+        # Routed fleet-rank identity is per-run too.
+        if getattr(args, "_fleet_rank", None) is not None:
+            for key, val in prior_rank_env.items():
+                if val is None:
+                    os.environ.pop(key, None)
+                else:
+                    os.environ[key] = val
 
 
 def _run(args, files: RunFiles) -> int:

@@ -63,6 +63,22 @@ class PreparedJob:
                               # reused while the topology signature holds)
 
 
+class PendingBatch:
+    """A launched-but-uncollected batch: the per-engine device outputs
+    of one `launch_eval` (jax async dispatch — the arrays are futures
+    until `collect` materializes them).  XLA runtime errors surface at
+    collect time; the driver maps them back through the same
+    quarantine bisection a synchronous raise takes."""
+
+    __slots__ = ("jobs", "J", "outs", "ev")
+
+    def __init__(self, jobs, J, outs, ev):
+        self.jobs = jobs
+        self.J = J
+        self.outs = outs      # [(engine, device-resident [jpad, L] lnl)]
+        self.ev = ev          # the evaluator lane that launched it
+
+
 def batch_eligible(inst) -> Optional[str]:
     """None when the instance can take the batched tier, else the
     human-readable reason it cannot (the driver degrades to sequential
@@ -95,6 +111,16 @@ class BatchEvaluator:
         self._jpads: dict = {}     # group key -> compiled pad sizes
         self._weights_pass = None  # (tree id, dispatch epoch) of the
                                    # last weights-batch CLV pass
+
+    def _const(self, eng, name: str):
+        """One engine constant (models / block_part / weights / tips /
+        site_rates) as THIS evaluator's dispatches should see it.  The
+        base evaluator reads the engine's live arrays (default device);
+        a DeviceShard (fleet/shard.py) overrides this with its
+        device-resident copies so the whole dispatch — committed
+        constants pull the uncommitted batch stacks after them — runs
+        on the shard's device."""
+        return getattr(eng, name)
 
     def _pick_jpad(self, group_key, J: int) -> int:
         """Batch pad size: the smallest ALREADY-COMPILED power of two
@@ -236,18 +262,40 @@ class BatchEvaluator:
         Bisection probes pass `record_occupancy=False`: the operator
         gauge must reflect the scheduled batches' real/padded ratio,
         not isolation sub-dispatches."""
+        return self.collect(self.launch_eval(jobs, record_occupancy))
+
+    def launch_eval(self, jobs: List[PreparedJob],
+                    record_occupancy: bool = True) -> "PendingBatch":
+        """ENQUEUE one same-key batch (one dispatch per engine) without
+        blocking on the result: jax dispatch is asynchronous, so a
+        multi-device driver (fleet/shard.py) launches one batch per
+        device and only then collects — the devices execute
+        concurrently instead of serializing behind each batch's host
+        sync."""
         assert jobs, "empty batch"
         assert len({j.key for j in jobs}) == 1, \
             "batch mixes job groups (driver bug)"
         J = len(jobs)
         jpad = self._pick_jpad(jobs[0].key, J)
-        M = len(self.inst.models)
-        per_part = np.full((J, M), np.nan)
         if record_occupancy:
             obs.gauge("fleet.batch_occupancy", J / jpad)
+        outs = []
         for eng in self.engines:
-            vals = (self._eval_fast(eng, jobs, jpad) if self.fast
-                    else self._eval_scan(eng, jobs, jpad))
+            out = (self._launch_fast(eng, jobs, jpad) if self.fast
+                   else self._launch_scan(eng, jobs, jpad))
+            outs.append((eng, out))
+        return PendingBatch(jobs, J, outs, self)
+
+    def collect(self, pending: "PendingBatch") -> np.ndarray:
+        """Materialize a launched batch's per-job per-partition lnL
+        [J, M] — THE blocking seam of the batched tier (registered
+        host-sync: the rows feed the results table and the fsync'd
+        journal, so the sync is the product)."""
+        J = pending.J
+        M = len(self.inst.models)
+        per_part = np.full((J, M), np.nan)
+        for eng, out in pending.outs:
+            vals = np.asarray(out)
             for li, gid in enumerate(eng.bucket.part_ids):
                 per_part[:, gid] = vals[:J, li]
         return per_part
@@ -259,7 +307,7 @@ class BatchEvaluator:
         scaler = jnp.zeros((jpad, rows, eng.B, eng.lane), jnp.int32)
         return clv, scaler
 
-    def _eval_fast(self, eng, jobs: List[PreparedJob], jpad: int):
+    def _launch_fast(self, eng, jobs: List[PreparedJob], jpad: int):
         profile = jobs[0].st.profile
         with obs.timer("host_schedule"):
             zs = [fastpath.refresh_z(j.st, j.flat, self.C, eng.dtype)
@@ -283,10 +331,13 @@ class BatchEvaluator:
                      self._pad_stack([jnp.int32(q) for _, q in pq], jpad),
                      self._pad_stack(
                          [jnp.asarray(j.z, eng.dtype) for j in jobs], jpad),
-                     eng.models, eng.block_part, eng.weights, eng.tips)
-        return np.asarray(out)
+                     self._const(eng, "models"),
+                     self._const(eng, "block_part"),
+                     self._const(eng, "weights"),
+                     self._const(eng, "tips"))
+        return out
 
-    def _eval_scan(self, eng, jobs: List[PreparedJob], jpad: int):
+    def _launch_scan(self, eng, jobs: List[PreparedJob], jpad: int):
         tvs = []
         with obs.timer("host_schedule"):
             for j in jobs:
@@ -314,9 +365,144 @@ class BatchEvaluator:
                            self._pad_stack(
                                [jnp.asarray(j.z, eng.dtype) for j in jobs],
                                jpad),
-                           eng.models, eng.block_part, eng.weights,
-                           eng.tips, eng.site_rates)
-        return np.asarray(out)
+                           self._const(eng, "models"),
+                           self._const(eng, "block_part"),
+                           self._const(eng, "weights"),
+                           self._const(eng, "tips"),
+                           self._const(eng, "site_rates"))
+        return out
+
+    # -- batched universal interpreter (mixed-profile novel jobs) ------------
+
+    def _uni_fn(self, eng, akey, npad: int, ppad: int, jpad: int):
+        """One compiled vmapped interpreter program per (alphabet,
+        table bucket, slot bucket, job pad): the per-job descriptor
+        TABLES are runtime data, so topologies with completely
+        different profiles batch through the same executable — the
+        class select is `lax.select_n` (ops/universal.py select=True),
+        computing all three tip-case branches and gathering one, which
+        keeps the arena writes outside any conditional under vmap."""
+        key = ("unibatch", akey, npad, ppad, jpad, self.C)
+        fn = eng.cache_get(key)
+        if fn is not None:
+            return fn
+        from examl_tpu.ops import universal
+        alpha = universal.alphabet(akey)
+
+        def body(clv, scaler, cls, slot, cbase, lidx, ridx, lcode,
+                 rcode, zl, zr, p_idx, q_idx, zv, dm, block_part,
+                 weights, tips):
+            apply = fastpath.chunk_applier(dm, block_part, tips,
+                                           eng.scale_exp,
+                                           eng.fast_precision)
+            clv, scaler = universal.run_universal(
+                alpha, cls, slot, cbase, lidx, ridx, lcode, rcode, zl,
+                zr, clv, scaler, apply.values, select=True)
+            return kernels.root_log_likelihood(
+                dm, block_part, weights, tips, clv, scaler, p_idx,
+                q_idx, zv, eng.num_parts, eng.scale_exp, eng.ntips,
+                None)
+
+        vb = jax.vmap(body, in_axes=(0,) * 14 + (None,) * 4)
+        return eng.cache_put(key, jax.jit(vb))
+
+    def launch_universal(self, jobs: List[PreparedJob], key,
+                         record_occupancy: bool = True) -> "PendingBatch":
+        """ENQUEUE one mixed-profile batch through the vmapped
+        universal interpreter: jobs grouped only by their BUCKETED
+        table/slot sizes (driver key ("uni", akey, npad, ppad)) share
+        one dispatch — novel-topology serving traffic batches instead
+        of dispatching solo.  Descriptor tables and padded index
+        copies reuse the engine's per-topology universal cache, so a
+        recurring topology ships only its two fresh z arrays."""
+        from examl_tpu.ops import universal
+        assert jobs
+        _, akey, npad, ppad = key
+        J = len(jobs)
+        jpad = self._pick_jpad(key, J)
+        if record_occupancy:
+            obs.gauge("fleet.batch_occupancy", J / jpad)
+        obs.inc("fleet.uni_batches")
+        outs = []
+        for eng in self.engines:
+            descs, idxs, zls, zrs = [], [], [], []
+            with obs.timer("host_schedule"):
+                for j in jobs:
+                    ent = eng._universal_entry(
+                        j.st.profile, np.asarray(j.st.base),
+                        (j.st.lidx, j.st.ridx, j.st.lcode, j.st.rcode),
+                        cache_key=j.flat.topo_key)
+                    desc = ent["desc"].get(npad)
+                    if desc is None:
+                        desc = ent["desc"][npad] = jax.device_put(
+                            list(universal.pad_table(ent["table"],
+                                                     npad)))
+                    idx = ent["pads"].get(ppad)
+                    if idx is None:
+                        idx = ent["pads"][ppad] = jax.device_put(
+                            [universal.pad_slots(np.asarray(a), ppad)
+                             for a in ent["idx"]])
+                    descs.append(desc)
+                    idxs.append(idx)
+                    zl, zr = fastpath.refresh_z(j.st, j.flat, self.C,
+                                                eng.dtype,
+                                                total_slots=ppad)
+                    zls.append(zl)
+                    zrs.append(zr)
+            fn = self._uni_fn(eng, akey, npad, ppad, jpad)
+            clv, scaler = self._batch_arenas(eng, jpad)
+            pq = [(self._gidx_st(j.st, j.p.number),
+                   self._gidx_st(j.st, j.p.back.number)) for j in jobs]
+            obs.inc("engine.dispatch_count")
+            with obs.device_span("fleet:batch_universal",
+                                 args={"jobs": J, "jpad": jpad,
+                                       "steps": npad}):
+                out = fn(clv, scaler,
+                         self._pad_stack([d[0] for d in descs], jpad),
+                         self._pad_stack([d[1] for d in descs], jpad),
+                         self._pad_stack([d[2] for d in descs], jpad),
+                         self._pad_stack([i[0] for i in idxs], jpad),
+                         self._pad_stack([i[1] for i in idxs], jpad),
+                         self._pad_stack([i[2] for i in idxs], jpad),
+                         self._pad_stack([i[3] for i in idxs], jpad),
+                         self._pad_stack(zls, jpad),
+                         self._pad_stack(zrs, jpad),
+                         self._pad_stack(
+                             [jnp.int32(p) for p, _ in pq], jpad),
+                         self._pad_stack(
+                             [jnp.int32(q) for _, q in pq], jpad),
+                         self._pad_stack(
+                             [jnp.asarray(j.z, eng.dtype)
+                              for j in jobs], jpad),
+                         self._const(eng, "models"),
+                         self._const(eng, "block_part"),
+                         self._const(eng, "weights"),
+                         self._const(eng, "tips"))
+            outs.append((eng, out))
+        return PendingBatch(jobs, J, outs, self)
+
+    def unibatch_key(self, prep: PreparedJob):
+        """The mixed-profile batch-group key for a novel-profile job:
+        ("uni", alphabet, table_bucket, slot_bucket) — a pure function
+        of the job's BUCKETED universal-table shape, so topologies
+        with entirely different profiles group together.  None when
+        the layout cannot run through the interpreter (legacy
+        unbounded chunks) — the driver falls back to solo routing."""
+        from examl_tpu.ops import universal
+        if prep.st is None:
+            return None
+        eng = self.engines[0]
+        try:
+            ent = eng._universal_entry(
+                prep.st.profile, np.asarray(prep.st.base),
+                (prep.st.lidx, prep.st.ridx, prep.st.lcode,
+                 prep.st.rcode),
+                cache_key=prep.flat.topo_key)
+        except universal.UniversalIneligible:
+            return None
+        table = ent["table"]
+        return ("uni", universal.alphabet_key(),
+                bucket_len(table.n_chunks), bucket_len(table.slots))
 
     # -- batched whole-tree gradient smoothing (--fleet-cycles) --------------
     # The sequential path paid the per-branch Newton loop PER JOB per

@@ -36,12 +36,13 @@ from __future__ import annotations
 
 import os
 import time
-from typing import Dict, List, Optional
+from typing import Callable, Dict, List, Optional
 
 import numpy as np
 
 from examl_tpu import obs
 from examl_tpu.fleet import bootstrap as _bootstrap
+from examl_tpu.fleet import lease as _lease
 from examl_tpu.fleet import quarantine
 from examl_tpu.fleet.batch import WEIGHTS_GROUP, batch_eligible
 from examl_tpu.fleet.jobs import JobSpec
@@ -55,7 +56,10 @@ class FleetDriver:
                  policy: Optional[quarantine.JobFaultPolicy] = None,
                  journal: Optional[quarantine.ResultsJournal] = None,
                  deadletters: Optional[quarantine.DeadLetters] = None,
-                 route_universal: bool = False):
+                 route_universal: bool = False,
+                 devices: int = 1,
+                 leases: Optional[_lease.LeaseBoard] = None,
+                 peer_journals: Optional[Callable[[], list]] = None):
         self.inst = inst
         self.start_tree = start_tree          # bootstrap topology (+ ckpt
         self.batch_cap = max(1, int(batch_cap))   # scaffold)
@@ -71,6 +75,24 @@ class FleetDriver:
         if reason is not None:
             self.log(f"fleet: batched tier unavailable ({reason}); "
                      "jobs evaluate one at a time")
+        # Tree-axis device sharding (fleet/shard.py): one evaluation
+        # lane per surviving local device; `devices` <= 1 keeps the
+        # classic single-lane behavior, 0 means every local device.
+        from examl_tpu.fleet.shard import ShardSet
+        if self.evaluator is not None and devices != 1:
+            self.shards = ShardSet(inst, self.evaluator,
+                                   max_devices=devices, log=self.log)
+        else:
+            self.shards = None       # single lane: the plain evaluator
+        # Durable per-rank job leases (fleet/lease.py): under a leased
+        # gang every rank leases jobs from the shared board; peers'
+        # fsync'd results journals are absorbed so a job finished by
+        # any rank finishes everywhere.
+        self.leases = leases
+        self.peer_journals = peer_journals
+        self._reap_after: Dict[str, float] = {}
+        self._reap_tries: Dict[str, int] = {}
+        self._last_absorb = 0.0
         # Zero-recompile serving (ops/universal.py): with routing on, a
         # tree job whose fastpath profile was never specialized runs
         # through the universal interpreter — one banked program per
@@ -96,6 +118,21 @@ class FleetDriver:
                 "EXAML_FLEET_SPECIALIZE_AFTER", "0") or 0))
         except ValueError:
             self._specialize_after = 0
+        # Mixed-profile batched-universal serving (ISSUE 14 / ROADMAP
+        # §8b): novel-profile jobs group by bucketed table shape and
+        # batch through ONE vmapped select_n interpreter program.
+        # MEASURED VERDICT (CPU, 24x400, 12 novel profiles): the
+        # select over all three tip-case branches costs ~3x per-step
+        # compute — warm batched 0.34x of solo — and a vmapped
+        # lax.switch would execute every branch too (its batching rule
+        # degenerates to the same select), so batching only pays where
+        # the launch floor dominates (J solo dispatches x latency >
+        # 3x compute): OFF by default, EXAML_FLEET_UNIBATCH=1 opts in
+        # for dispatch-bound backends; `fleet.universal_retrace`
+        # counts the solo dispatches a batched program would merge —
+        # the evidence for re-measuring on-chip.
+        self._unibatch = os.environ.get("EXAML_FLEET_UNIBATCH",
+                                        "") == "1"
         if self.route_universal:
             # The sequential/bisection-leaf paths must route novel
             # profiles identically, so a quarantine probe is
@@ -253,12 +290,25 @@ class FleetDriver:
             if self.route_universal and not compiled and not (
                     self._specialize_after
                     and seen + 1 >= self._specialize_after):
-                # Route through the interpreter one job at a time (the
-                # engine's universal tier; batching novel profiles
-                # under vmap would re-trace every switch branch per
-                # job — noted future work).  The job id keys a
-                # singleton group.
-                key = ("uniseq", job.job_id)
+                # Route through the interpreter.  By default novel
+                # profiles group by their BUCKETED universal-table
+                # shape and batch through the vmapped select_n
+                # interpreter program (batch.py launch_universal) —
+                # mixed-profile serving traffic compiles ONCE.  With
+                # EXAML_FLEET_UNIBATCH=0 (or an ineligible layout)
+                # each job dispatches solo through the engine's
+                # switch-based interpreter; `fleet.universal_retrace`
+                # counts those solo dispatches — the batching the
+                # vmapped program would have merged.
+                ub = (self.evaluator.unibatch_key(
+                          self._prepared[job.job_id])
+                      if self._unibatch and self.evaluator is not None
+                      and self.evaluator.fast else None)
+                if ub is not None:
+                    key = ub
+                else:
+                    obs.inc("fleet.universal_retrace")
+                    key = ("uniseq", job.job_id)
         return key
 
     def _profile_compiled(self, profile) -> bool:
@@ -300,6 +350,8 @@ class FleetDriver:
         if self.deadletters is not None:
             self.deadletters.append(job, cause, error)
         self._journal_job(job)
+        if self.leases is not None:
+            self.leases.release(job.job_id)
         self.log(f"fleet: job {job.job_id} QUARANTINED ({cause} after "
                  f"{job.attempts} attempt(s): {error})")
 
@@ -343,6 +395,13 @@ class FleetDriver:
         """Run batches until no job is pending."""
         from examl_tpu.resilience import heartbeat
         while True:
+            if self.leases is not None:
+                # A leased rank first absorbs peers' journaled results
+                # (a job finished by ANY rank finishes everywhere) and
+                # renews the leases it still holds so a long queue
+                # never lets its own leases expire under it.
+                self._absorb_remote()
+                self._renew_leases()
             pending = self.pending()
             obs.gauge("fleet.queue_depth", len(pending))
             # "done" means SUCCEEDED: failed jobs leave the queue but
@@ -360,11 +419,14 @@ class FleetDriver:
             now = time.time()
             ready = [j for j in pending
                      if self._not_before.get(j.job_id, 0.0) <= now]
+            if self.leases is not None:
+                ready = self._lease_ready(ready, now)
             if not ready:
-                wake = min(self._not_before.get(j.job_id, now)
-                           for j in pending)
+                wake = min((self._not_before.get(j.job_id, now)
+                            for j in pending), default=now)
                 heartbeat.phase_beat("FLEET")
-                time.sleep(min(max(wake - now, 0.01), 1.0))
+                floor = 0.25 if self.leases is not None else 0.01
+                time.sleep(min(max(wake - now, floor), 1.0))
                 continue
             # Group by batch key; dispatch the largest group first so
             # occupancy stays high while the queue is deep.  A job that
@@ -394,23 +456,31 @@ class FleetDriver:
                 groups.setdefault(key, []).append(job)
             if not groups:
                 continue                       # everything failed: re-check
-            batch = max(groups.values(), key=len)[:self.batch_cap]
-            # The heartbeat IS the fleet's iteration clock: supervise
-            # stall detection, search.kill chaos addressing, and the
-            # periodic metrics flush all tick here.  The payload
-            # DECLARES the in-flight batch (job ids + wall-clock
-            # deadline): a --supervise parent seeing the beat go stale
-            # past the deadline kills the attempt as JOB-stuck — the
-            # batch's jobs pay attempts, the run keeps its retries.
-            fl = {"jobs": [j.job_id for j in batch]}
-            if self.policy.deadline_s > 0:
-                fl["deadline"] = time.time() + self.policy.deadline_s
-            heartbeat.beat("FLEET", payload={"fleet": fl})
-            self._dispatch(batch)
+            # Cut up to one batch per device lane, largest group first
+            # (a single deep group splits across lanes — the jobs are
+            # independent, so any cut is valid), and round-robin the
+            # cuts across the shard set.
+            nlanes = len(self.shards) if self.shards is not None else 1
+            order = sorted(groups.items(),
+                           key=lambda kv: (-len(kv[1]), str(kv[0])))
+            batches: List = []
+            for key, members in order:
+                for i in range(0, len(members), self.batch_cap):
+                    batches.append((key, members[i:i + self.batch_cap]))
+                    if len(batches) >= nlanes:
+                        break
+                if len(batches) >= nlanes:
+                    break
+            assignments = []
+            for lane, (key, batch) in enumerate(batches):
+                shard = (self.shards.shard_for(key, lane)
+                         if self.shards is not None else self.evaluator)
+                assignments.append((shard, batch))
+            self._dispatch_round(assignments)
             # Clear the in-flight declaration: a later non-fleet wedge
             # (checkpoint I/O, model push) must not be misattributed to
             # jobs that already finished.  phase_beat: bookkeeping, not
-            # an iteration — the search.kill clock stays one per batch.
+            # an iteration — the search.kill clock stays one per round.
             heartbeat.phase_beat("FLEET", payload={"fleet": None})
             self._batches_since_ckpt += 1
             if self.mgr is not None and \
@@ -421,6 +491,16 @@ class FleetDriver:
                 # a pending SIGTERM/SIGINT exits resumable HERE (exit
                 # 75; a --supervise parent resumes without consuming a
                 # retry) — at most the next batch's cycle is redone.
+                from examl_tpu.resilience import preempt
+                preempt.check_after_checkpoint(log=self.log)
+            elif self.mgr is None and self.leases is not None:
+                # Leased serving checkpoints nothing (the per-job
+                # fsync'd journal is the durable record, and lockstep
+                # two-phase gang checkpoints cannot apply to ranks
+                # that are deliberately NOT in lockstep) — but the
+                # preemption contract still holds at the same cadence:
+                # everything finished is journaled, so exiting 75 here
+                # loses only in-flight compute.
                 from examl_tpu.resilience import preempt
                 preempt.check_after_checkpoint(log=self.log)
         obs.gauge("fleet.queue_depth", 0)
@@ -439,41 +519,261 @@ class FleetDriver:
                 else self.inst.random_tree(seed=0)
         self.mgr.write("FLEET", self.extras(), self.inst, tree)
 
+    # -- lease bookkeeping (the rank-level fault domain) --------------------
+
+    def _absorb_remote(self) -> None:
+        """Fold peers' journaled results into the local job table: a
+        job any rank finished (done OR quarantined) finishes here too —
+        WITHOUT re-emitting its `job.done` (the finishing rank already
+        did, and the merged-ledger acceptance counts them exactly
+        once).  Also the expired-but-journaled guard: an absorbed job
+        is no longer pending, so its stale lease is scrubbed instead of
+        reaped-and-re-dispatched."""
+        if self.peer_journals is None:
+            return
+        now = time.time()
+        if now - self._last_absorb < 0.5:
+            return
+        self._last_absorb = now
+        try:
+            recs = self.peer_journals()
+        except OSError:
+            return
+        by_id = {r.get("job_id"): r for r in recs if r.get("done")}
+        for job in self.jobs:
+            if job.done:
+                continue
+            rec = by_id.get(job.job_id)
+            if rec is None:
+                continue
+            rj = JobSpec.from_dict({k: v for k, v in rec.items()
+                                    if k != "t"})
+            job.cycles_done = rj.cycles_done
+            job.lnl = rj.lnl
+            job.done = True
+            job.failed = rj.failed
+            job.attempts = max(job.attempts, rj.attempts)
+            job.cause = rj.cause
+            job.last_error = rj.last_error
+            if rj.newick:
+                job.newick = rj.newick
+            obs.inc("fleet.jobs_absorbed")
+            self._evict(job)
+            self._reap_after.pop(job.job_id, None)
+            if self.leases is not None:
+                self.leases.scrub(job.job_id)
+
+    def _renew_leases(self) -> None:
+        for jid in self.leases.held():
+            self.leases.renew(jid)
+
+    def _lease_ready(self, ready: List[JobSpec],
+                     now: float) -> List[JobSpec]:
+        """The leased view of the ready set: only jobs THIS rank holds
+        a lease on may dispatch.  Free jobs are acquired (bounded to
+        ~2 rounds of work so one rank never hogs the whole shared
+        queue), live foreign leases wait, and expired foreign leases —
+        a dead rank's in-flight jobs — are reaped after a
+        blake2b-jittered backoff so surviving ranks never stampede the
+        steal."""
+        out: List[JobSpec] = []
+        nlanes = len(self.shards) if self.shards is not None else 1
+        cap = 2 * nlanes * self.batch_cap
+        held = set(self.leases.held())
+        nheld = len(held)
+        claimed = False
+        for job in ready:
+            jid = job.job_id
+            if jid in held:
+                out.append(job)
+                continue
+            if nheld >= cap:
+                continue
+            state = self.leases.expired(jid)
+            if state is None:                      # free: claim it
+                if self.leases.acquire(jid):
+                    out.append(job)
+                    claimed = True
+                    nheld += 1
+                continue
+            if state is False:
+                if self.leases.stale_own(jid):
+                    # A dead predecessor of THIS rank slot held it: a
+                    # restarted rank reclaims its own lost jobs NOW
+                    # instead of idling out the ttl.
+                    if self.leases.reap(jid, own=True):
+                        self.log(f"fleet: reclaimed own stale lease "
+                                 f"for {jid} (restarted rank)")
+                        out.append(job)
+                        claimed = True
+                        nheld += 1
+                    continue
+                continue                           # live foreign lease
+            due = self._reap_after.get(jid)
+            if due is None:
+                att = self._reap_tries.get(jid, 0) + 1
+                self._reap_tries[jid] = att
+                self._reap_after[jid] = now + _lease.reap_backoff(
+                    jid, self.leases.rank, att)
+                continue
+            if now < due:
+                continue
+            self._reap_after.pop(jid, None)
+            if self.leases.reap(jid):
+                self.log(f"fleet: reaped expired lease for {jid} "
+                         "(its rank died or stalled); re-dispatching")
+                out.append(job)
+                claimed = True
+                nheld += 1
+        if claimed:
+            # Close the release-vs-stale-journal race: a finishing rank
+            # journals BEFORE it releases (fsync'd), so any job we just
+            # saw free-or-expired and claimed has its result VISIBLE
+            # now if it ever finished.  Force a journal re-read (past
+            # the absorb rate limit) and drop claimed-but-done jobs —
+            # `_absorb_remote` scrubs their just-taken leases — before
+            # a single duplicate dispatch can happen.
+            self._last_absorb = 0.0
+            self._absorb_remote()
+            out = [j for j in out if not j.done]
+        return out
+
+    def _fenced(self, job: JobSpec) -> bool:
+        """True when a leased job's completion must be DISCARDED: the
+        lease expired under us and another rank reaped it mid-dispatch.
+        The reaper owns the job now — recording our result too would
+        double-count it (the exactly-once `job.done` contract)."""
+        if self.leases is None:
+            return False
+        if self.leases.still_mine(job.job_id):
+            return False
+        obs.inc("fleet.leases_lost")
+        self.log(f"fleet: lease for {job.job_id} was reaped mid-"
+                 "dispatch; discarding this result (the reaper owns "
+                 "the job)")
+        return True
+
     # -- batch dispatch ------------------------------------------------------
 
     def _dispatch(self, batch: List[JobSpec]) -> None:
-        for job in batch:
-            if job.job_id not in self._started:
-                self._started.add(job.job_id)
-                obs.ledger_event("job.start", job=job.job_id,
-                                 job_kind=job.kind, index=job.index,
-                                 seed=job.seed, cycle=job.cycles_done)
-        obs.ledger_event("batch.dispatch", jobs=len(batch),
-                         job_kind=batch[0].kind,
-                         ids=",".join(j.job_id for j in batch[:8]))
+        """Single-batch dispatch (bisection-era entry point, kept for
+        harnesses): one round with one lane."""
+        shard = (self.shards.shard_for(self._keys.get(batch[0].job_id),
+                                       0)
+                 if self.shards is not None else self.evaluator)
+        self._dispatch_round([(shard, batch)])
+
+    def _dispatch_round(self, assignments: List) -> None:
+        """One drain round: LAUNCH every lane's batch (jax async
+        dispatch — lanes on distinct devices execute concurrently),
+        then collect and run each batch through the job-level fault
+        ladder.  A failed collect takes the same quarantine bisection a
+        synchronous raise always took."""
+        for _, batch in assignments:
+            for job in batch:
+                if job.job_id not in self._started:
+                    self._started.add(job.job_id)
+                    obs.ledger_event("job.start", job=job.job_id,
+                                     job_kind=job.kind, index=job.index,
+                                     seed=job.seed, cycle=job.cycles_done)
+        from examl_tpu.resilience import heartbeat
         compiles0 = obs.counter("engine.compile_count")
         bisects0 = obs.counter("fleet.bisect_dispatches")
         t0 = time.perf_counter()
-        # Job-level isolation: a raise anywhere inside the batched
-        # dispatch bisects to the guilty job(s); every healthy
-        # cohabitant keeps its result (bit-identical to a clean run —
-        # per-row vmap independence, pinned by tests/test_quarantine).
-        results = quarantine.isolate(batch, self._evaluate_batch,
-                                     self._evaluate_leaf)
+
+        def declare(batch):
+            """The in-flight declaration stays per-BATCH even in a
+            multi-lane round: the launch loop and the collect loop are
+            sequential host code, so (re)declaring exactly the batch
+            the host is about to block on keeps hang attribution as
+            tight as the single-lane flow — a deadline kill indicts
+            one batch's jobs, never innocent cohabitant lanes."""
+            fl = {"jobs": [j.job_id for j in batch]}
+            if self.policy.deadline_s > 0:
+                fl["deadline"] = time.time() + self.policy.deadline_s
+            return {"fleet": fl}
+
+        launches = []
+        for shard, batch in assignments:
+            lane = getattr(shard, "index", 0)
+            obs.ledger_event("batch.dispatch", jobs=len(batch),
+                             job_kind=batch[0].kind, lane=lane,
+                             ids=",".join(j.job_id for j in batch[:8]))
+            obs.inc(f"fleet.device_dispatches.d{lane}")
+            obs.inc(f"fleet.device_jobs.d{lane}", len(batch))
+            # The heartbeat IS the fleet's iteration clock: supervise
+            # stall detection, search.kill chaos addressing ("the Nth
+            # batch"), and the periodic metrics flush all tick here,
+            # once per BATCH — identical to the single-lane flow.
+            heartbeat.beat("FLEET", payload=declare(batch))
+            try:
+                launches.append(self._launch_batch(batch, shard))
+            except Exception as exc:      # noqa: BLE001 — attributed
+                launches.append(exc)      # through bisection below
+        njobs = 0
+        for (shard, batch), launched in zip(assignments, launches):
+            # Narrow the declaration to the batch this collect blocks
+            # on (bookkeeping re-publish, not an iteration: the
+            # search.kill clock stays one tick per batch).
+            if len(assignments) > 1:
+                heartbeat.phase_beat("FLEET", payload=declare(batch))
+            # Job-level isolation: a raise anywhere inside the batched
+            # dispatch bisects to the guilty job(s); every healthy
+            # cohabitant keeps its result (bit-identical to a clean run
+            # — per-row vmap independence, pinned by test_quarantine).
+            results = self._isolate_launched(batch, launched, shard)
+            njobs += len(batch)
+            obs.inc("fleet.batches")
+            obs.inc("fleet.trees_evaluated", len(batch))
+            self._apply_results(batch, results)
         dt = time.perf_counter() - t0
-        obs.inc("fleet.batches")
-        obs.inc("fleet.trees_evaluated", len(batch))
         obs.inc("fleet.eval_seconds", dt)
         clean = obs.counter("fleet.bisect_dispatches") == bisects0
-        # The throughput gauge only takes WARM, CLEAN batches: a batch
+        # The throughput gauge only takes WARM, CLEAN rounds: a round
         # whose wall contained a first-call compile (or a bisection
         # cascade) would publish a near-zero trees/sec wrongly read as
         # serving throughput (the same discipline as the engine's
         # bandwidth windows).
         if dt > 0 and clean \
                 and obs.counter("engine.compile_count") == compiles0:
-            obs.gauge("fleet.trees_per_sec", round(len(batch) / dt, 3))
+            obs.gauge("fleet.trees_per_sec", round(njobs / dt, 3))
+
+    def _isolate_launched(self, batch: List[JobSpec], launched,
+                          shard) -> List:
+        """Resolve one launched batch through `quarantine.isolate`
+        without re-running the clean path: the already-launched outcome
+        (collected rows, or the exception that killed launch/collect)
+        stands in for isolate's first top-level dispatch, so fault-hit
+        counters tick exactly as in the synchronous flow."""
+        if isinstance(launched, Exception):
+            outcome = launched
+        else:
+            try:
+                outcome = self._finish_batch(batch, launched)
+            except Exception as exc:      # noqa: BLE001
+                outcome = exc
+        consumed: List[int] = []
+
+        def evaluate(b, nested=False):
+            if not nested and not consumed:
+                consumed.append(1)
+                if isinstance(outcome, Exception):
+                    raise outcome
+                return outcome
+            return self._evaluate_batch(b, nested, shard=shard)
+
+        return quarantine.isolate(
+            batch, evaluate,
+            lambda j: self._evaluate_leaf(j, shard=shard))
+
+    def _apply_results(self, batch: List[JobSpec], results: List) -> None:
         for job, row, err in results:
+            if self._fenced(job):
+                # The reaper owns this job now: no attempt burned, no
+                # result recorded, nothing re-dispatched by us — the
+                # job completes (exactly once) on the reaper's rank
+                # and arrives back here through journal absorption.
+                continue
             if err is not None:
                 cause = (quarantine.CAUSE_POISON
                          if isinstance(err, FloatingPointError)
@@ -509,16 +809,31 @@ class FleetDriver:
                 # what a post-SIGKILL resume reconciles against the
                 # (older, per-batch) checkpoint.
                 self._journal_job(job)
+                if self.leases is not None:
+                    # Journal first, THEN release: a kill between the
+                    # two leaves an expired lease whose reap consults
+                    # the journal — absorbed, never re-run.
+                    self.leases.release(job.job_id)
                 self._evict(job)
 
     # -- the evaluation seams (fault-injectable, bisectable) ----------------
 
     def _evaluate_batch(self, batch: List[JobSpec],
-                        nested: bool = False) -> np.ndarray:
-        """One batched dispatch.  The fleet fault points live here —
-        the real seam where a poison job, a hang inside a batched
-        dispatch, or a whole-dispatch failure strikes.  `nested` marks
-        a bisection sub-dispatch (occupancy gauge suppressed)."""
+                        nested: bool = False, shard=None) -> np.ndarray:
+        """One batched dispatch, synchronously: launch + finish.  Used
+        by the bisection ladder (`nested` marks a sub-dispatch;
+        occupancy gauge suppressed) and by single-lane harnesses."""
+        return self._finish_batch(batch,
+                                  self._launch_batch(batch, shard, nested))
+
+    def _launch_batch(self, batch: List[JobSpec], shard=None,
+                      nested: bool = False):
+        """ENQUEUE one batch on a lane.  The fleet fault points live
+        here — the real seam where a poison job, a hang inside a
+        batched dispatch, or a whole-dispatch failure strikes.  Returns
+        a PendingBatch (async, collected in `_finish_batch`) or a host
+        ndarray for the synchronous paths (bootstrap weights,
+        sequential/universal-routed jobs)."""
         faults.fire("fleet.dispatch")
         for job in batch:
             # A REAL sleep (not beat suppression): the in-flight
@@ -526,16 +841,24 @@ class FleetDriver:
             # stale exactly like a genuine hang inside the batch.
             faults.fire("fleet.job.hang", job=job.job_id)
         if batch[0].kind == "bootstrap":
-            per_part = self._dispatch_bootstrap(batch, nested)
-        else:
-            per_part = self._dispatch_trees(batch, nested)
-        per_part = np.asarray(per_part, dtype=np.float64)
+            return self._dispatch_bootstrap(batch, nested)
+        return self._dispatch_trees(batch, nested, shard)
+
+    def _finish_batch(self, batch: List[JobSpec], launched) -> np.ndarray:
+        """Materialize one launched batch (the lane's registered
+        blocking collect) and apply the per-job poison fault — the
+        seam order is identical to the old synchronous dispatch, so
+        fault addressing and chaos tests are unchanged."""
+        from examl_tpu.fleet.batch import PendingBatch
+        if isinstance(launched, PendingBatch):
+            launched = launched.ev.collect(launched)
+        per_part = np.asarray(launched, dtype=np.float64)
         for i, job in enumerate(batch):
             if faults.fire("fleet.job.poison", job=job.job_id):
                 per_part[i] = np.nan
         return per_part
 
-    def _evaluate_leaf(self, job: JobSpec) -> np.ndarray:
+    def _evaluate_leaf(self, job: JobSpec, shard=None) -> np.ndarray:
         """Bisection leaf: ONE job through the one-at-a-time path the
         batched tier is parity-pinned against — so a healthy job
         isolated out of a poisoned batch scores bit-identically to a
@@ -625,17 +948,27 @@ class FleetDriver:
                     self._prepared.get(job.job_id))
 
     def _dispatch_trees(self, batch: List[JobSpec],
-                        nested: bool = False) -> np.ndarray:
+                        nested: bool = False, shard=None):
         # Later cycles smooth branch lengths before re-evaluating (the
         # multi-start refinement loop); cycle 0 scores the tree as is.
+        # Smoothing runs synchronously on the PRIMARY lane (the live
+        # engine arenas anchor it there) before the lane launch.
         self._smooth_if_due(batch)
         key = self._keys.get(batch[0].job_id)
         routed = (isinstance(key, tuple) and key
                   and key[0] == "uniseq")
-        if self.evaluator is not None and not routed:
+        ev = shard if shard is not None else self.evaluator
+        if ev is not None and isinstance(key, tuple) and key \
+                and key[0] == "uni":
+            # Mixed-profile batch through the vmapped universal
+            # interpreter (primary lane: the per-topology descriptor
+            # caches are device-resident there).
             preps = [self._prepared[j.job_id] for j in batch]
-            return self.evaluator.eval_batch(
-                preps, record_occupancy=not nested)
+            return ev.launch_universal(preps, key,
+                                       record_occupancy=not nested)
+        if ev is not None and not routed:
+            preps = [self._prepared[j.job_id] for j in batch]
+            return ev.launch_eval(preps, record_occupancy=not nested)
         # Sequential: no batched tier, or a universal-routed job — the
         # instance's evaluate path, where the engine's novel-profile
         # routing dispatches the topology-as-data interpreter.

@@ -156,6 +156,84 @@ def isolate(batch: List, evaluate: Callable, leaf: Callable,
 # -- durable results journal -------------------------------------------------
 
 
+def journal_path(workdir: str, run_id: str,
+                 rank: Optional[int] = None) -> str:
+    """The results-journal naming rule.  Single-process fleets keep the
+    classic `ExaML_fleetJournal.<run>`; a LEASED GANG (fleet/lease.py)
+    writes one journal PER RANK (`.r<k>` suffix) so concurrent ranks
+    never interleave appends in one file — readers merge the set."""
+    base = os.path.join(workdir, f"ExaML_fleetJournal.{run_id}")
+    return base if rank is None else f"{base}.r{rank}"
+
+
+def read_all_journals(workdir: str, run_id: str) -> List[dict]:
+    """Every rank's journal records, merged: the base journal plus any
+    `.r<k>` rank journals (two explicit globs — a bare `<run>*` pattern
+    would also match a DIFFERENT run id that merely extends this one)."""
+    import glob as _glob
+    paths = sorted(set(
+        _glob.glob(journal_path(workdir, run_id))
+        + _glob.glob(journal_path(workdir, run_id) + ".r*")))
+    recs: List[dict] = []
+    for p in paths:
+        recs.extend(r for r in _ledger.read_events(p) if r.get("job_id"))
+    return recs
+
+
+class JournalTail:
+    """Incremental reader over a run's per-rank journals: the absorb
+    loop polls twice a second for the whole life of a serve rank, and
+    re-parsing every record of every journal from byte 0 each tick is
+    O(total finished jobs) per tick — quadratic over a long run.  The
+    journals are append-only, so this keeps a byte offset per file and
+    parses only the tail; an incomplete final line (no newline yet —
+    the mid-append read) is NOT consumed, the ledger discipline at the
+    byte level.  A file that SHRANK (a peer's fresh-run cleanup
+    recreated it) resets to 0 — absorption is idempotent, so a
+    re-read is safe."""
+
+    def __init__(self, workdir: str, run_id: str):
+        self.workdir = workdir
+        self.run_id = run_id
+        self._offsets: Dict[str, int] = {}
+        self._records: Dict[str, dict] = {}   # job_id -> newest record
+
+    def _paths(self) -> List[str]:
+        import glob as _glob
+        return sorted(set(
+            _glob.glob(journal_path(self.workdir, self.run_id))
+            + _glob.glob(journal_path(self.workdir, self.run_id)
+                         + ".r*")))
+
+    def records(self) -> List[dict]:
+        for path in self._paths():
+            off = self._offsets.get(path, 0)
+            try:
+                if os.path.getsize(path) < off:
+                    off = 0               # truncated/recreated: re-read
+                with open(path, "rb") as f:
+                    f.seek(off)
+                    chunk = f.read()
+            except OSError:
+                continue
+            if not chunk:
+                continue
+            complete, _, torn = chunk.rpartition(b"\n")
+            if complete:
+                for line in complete.split(b"\n"):
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        rec = json.loads(line)
+                    except ValueError:
+                        continue          # garbage line: consumed
+                    if isinstance(rec, dict) and rec.get("job_id"):
+                        self._records[rec["job_id"]] = rec
+            self._offsets[path] = off + len(chunk) - len(torn)
+        return list(self._records.values())
+
+
 class ResultsJournal:
     """Append-only fsync'd per-run JSONL of *finished* jobs (done or
     quarantined).  The checkpoint covers the whole job table but is

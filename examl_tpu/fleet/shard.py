@@ -1,0 +1,128 @@
+"""Tree-axis device sharding: one evaluation lane per local device.
+
+The fleet's jobs are independent, so the second parallel axis (ROADMAP
+§8, after PR8's batch axis over trees) is data parallelism across the
+host's LOCAL DEVICES: the profile-grouped queue round-robins its
+largest groups across one `BatchEvaluator` lane per device — scaling is
+near-linear because nothing synchronizes between lanes (Large Scale
+Distributed Linear Algebra With TPUs, PAPERS.md 2112.09017, is the
+discipline exemplar: shard the independent axis, keep each chip's
+program whole).
+
+Mechanics: every engine constant the batched programs consume (models,
+block_part, weights, tips, site_rates) is copied to the lane's device
+at init (`jax.device_put`); the per-batch stacks and fresh arenas are
+committed to the same device, so the whole dispatch executes there.
+Dispatch is two-phase — `launch_eval` enqueues (jax async dispatch),
+`collect` materializes — so D lanes run concurrently instead of
+serializing behind each batch's host sync.
+
+Fault domain: a device that fails INIT (a dead plugin, an OOM on
+constant upload, a failed probe dispatch) degrades the set to the
+surviving lanes — counter `fleet.device_degraded`, an operator log
+line, never an abort.  The primary lane is the instance's own
+evaluator on the default device and also owns the work the live engine
+arenas anchor there: shared-topology weight batches, `--fleet-cycles`
+smoothing, and universal-interpreter routing.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from examl_tpu import obs
+from examl_tpu.fleet.batch import WEIGHTS_GROUP, BatchEvaluator
+
+# Engine constants the batched dispatch bodies take as arguments — the
+# full set a lane must hold device-resident copies of.
+_CONST_NAMES = ("models", "block_part", "weights", "tips", "site_rates")
+
+
+class DeviceShard(BatchEvaluator):
+    """A BatchEvaluator whose dispatches run on one specific device."""
+
+    def __init__(self, inst, device, index: int):
+        super().__init__(inst)
+        self.device = device
+        self.index = int(index)
+        self._consts = {}
+        for eng in self.engines:
+            self._consts[id(eng)] = {
+                name: (None if getattr(eng, name) is None
+                       else jax.device_put(getattr(eng, name), device))
+                for name in _CONST_NAMES}
+        # Probe the device with a real tiny dispatch: a lane that
+        # cannot even add two scalars must degrade at INIT, not
+        # mis-attribute its first real batch to a poison job.
+        probe = jax.device_put(jnp.ones((), jnp.float32), device)
+        float(probe + 1.0)
+
+    def _const(self, eng, name: str):
+        return self._consts[id(eng)][name]
+
+    def _pad_stack(self, arrs, jpad: int):
+        arrs = list(arrs) + [arrs[0]] * (jpad - len(arrs))
+        return jax.device_put(jnp.stack([jnp.asarray(a) for a in arrs]),
+                              self.device)
+
+    def _batch_arenas(self, eng, jpad: int):
+        clv, scaler = BatchEvaluator._batch_arenas(self, eng, jpad)
+        return (jax.device_put(clv, self.device),
+                jax.device_put(scaler, self.device))
+
+
+class ShardSet:
+    """The drivable set of evaluation lanes: the primary evaluator
+    (default device — also the weights-batch / smoothing / universal
+    lane) plus one DeviceShard per surviving additional local device."""
+
+    def __init__(self, inst, primary: Optional[BatchEvaluator],
+                 max_devices: int = 0, log=None):
+        log = log or (lambda *_: None)
+        self.inst = inst
+        self.shards: List[BatchEvaluator] = []
+        if primary is None:
+            # No batched tier (SEV / sharded instances): the driver
+            # evaluates sequentially; device sharding does not apply.
+            obs.gauge("fleet.devices", 0)
+            return
+        self.shards.append(primary)
+        devices = list(jax.local_devices())
+        if max_devices and max_devices > 0:
+            devices = devices[:max_devices]
+        for i, dev in enumerate(devices[1:], start=1):
+            try:
+                self.shards.append(DeviceShard(inst, dev, i))
+            except Exception as exc:  # noqa: BLE001 — device-level
+                # fault domain: one bad device degrades the set, it
+                # must never abort a serving process.
+                obs.inc("fleet.device_degraded")
+                log(f"fleet: device {dev} degraded at init ({exc}); "
+                    f"continuing with {len(self.shards)} lane(s)")
+        obs.gauge("fleet.devices", len(self.shards))
+        if len(self.shards) > 1:
+            log(f"fleet: tree-axis sharding over {len(self.shards)} "
+                "local device lane(s)")
+
+    def __len__(self) -> int:
+        return len(self.shards)
+
+    @property
+    def primary(self) -> Optional[BatchEvaluator]:
+        return self.shards[0] if self.shards else None
+
+    def shard_for(self, key, lane: int) -> BatchEvaluator:
+        """The lane for a batch.  Groups anchored to the live engine
+        arenas — shared-topology weight batches and universal-routed
+        solo jobs — always run on the primary lane; everything else
+        round-robins."""
+        if not self.shards:
+            raise ValueError("no device lanes")
+        if key == WEIGHTS_GROUP or (
+                isinstance(key, tuple) and key
+                and key[0] in ("uniseq", "seq", "uni")):
+            return self.shards[0]
+        return self.shards[lane % len(self.shards)]

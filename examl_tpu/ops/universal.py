@@ -208,7 +208,7 @@ def pad_slots(arr: np.ndarray, ppad: int, fill=0) -> np.ndarray:
 
 
 def run_universal(alpha, cls, slot, cbase, lidx, ridx, lcode, rcode,
-                  zl, zr, clv, scaler, values):
+                  zl, zr, clv, scaler, values, select: bool = False):
     """The interpreter body (traced): one `lax.scan` over the
     descriptor table; each step `lax.switch`es to its tip-case class,
     dynamic-slices the floor-width windows out of the packed arrays at
@@ -217,7 +217,18 @@ def run_universal(alpha, cls, slot, cbase, lidx, ridx, lcode, rcode,
     `fastpath.chunk_applier`).  The arena writes happen here, outside
     the conditional, so the carry is never copied through the switch.
     Program length is O(1) regardless of topology or table length —
-    THE property that makes the jit key topology-independent."""
+    THE property that makes the jit key topology-independent.
+
+    `select=True` replaces the `lax.switch` with `lax.select_n` over
+    ALL THREE class branches — a gather-style select of computed
+    values, bit-identical to the switch (select_n picks one branch's
+    exact results; no arithmetic blending) at ~3x the per-step compute.
+    This is the VMAPPED (fleet unibatch) form: under vmap a batched
+    switch index degenerates to executing every branch anyway, and the
+    explicit select keeps the arena writes outside any conditional
+    (the GL001 cond-write hazard cannot re-enter through a batching
+    rule) while letting MIXED-PROFILE job batches share one compiled
+    program — the tables differ per job, the program does not."""
     import jax
     import jax.numpy as jnp
 
@@ -240,7 +251,12 @@ def run_universal(alpha, cls, slot, cbase, lidx, ridx, lcode, rcode,
     def body(carry, x):
         c, s = carry
         ci, off, b = x
-        v, sc = jax.lax.switch(ci, branches, c, s, off)
+        if select:
+            outs = [br(c, s, off) for br in branches]
+            v = jax.lax.select_n(ci, *[v for v, _ in outs])
+            sc = jax.lax.select_n(ci, *[sc for _, sc in outs])
+        else:
+            v, sc = jax.lax.switch(ci, branches, c, s, off)
         z0 = jnp.zeros((), b.dtype)
         c = jax.lax.dynamic_update_slice(c, v.astype(c.dtype),
                                          (b, z0, z0, z0, z0))

@@ -47,6 +47,14 @@ CAUSE_STRAGGLER = "straggler-stall"  # ONE rank stopped beating while
                                    # its peers stayed fresh: a rank-local
                                    # stall (lockstep means the fresh
                                    # peers are already blocked on it)
+CAUSE_FLEET_RANK_DEATH = "fleet-rank-death"  # a LEASED fleet gang rank
+                                   # died: fleet gangs are NOT lockstep
+                                   # (jobs are independent, held under
+                                   # per-rank leases), so the watcher
+                                   # restarts ONLY that rank — no
+                                   # gang-wide kill, no tier pin, no
+                                   # run-level retry; the dead rank's
+                                   # leases expire and peers reap them
 CAUSE_FLEET_JOB_STUCK = "fleet-job-stuck"  # the fleet heartbeat named an
                                    # in-flight batch whose per-job
                                    # deadline expired: a JOB-level fault

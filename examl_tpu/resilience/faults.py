@@ -38,6 +38,8 @@ Registered points (seam → default action):
     fleet.job.poison   fleet dispatch, poisons ONE job's lnL  → flag (sticky)
     fleet.job.hang     fleet dispatch while job ID is batched → hang
     fleet.results.write  fleet results-journal append         → raise
+    fleet.lease.write  lease-board publish (stage/fsync)      → raise
+    fleet.lease.reap   expired-lease reap steal               → raise
 
 `flag` points have no side effect here — `fire()` returns True and the
 seam implements the failure (NaN substitution, beat suppression).
@@ -77,6 +79,8 @@ POINTS = {
                         "sticky — a poison job stays poison on retry)",
     "fleet.job.hang": "hang the fleet dispatch while job ID is batched",
     "fleet.results.write": "fail a fleet results-journal append",
+    "fleet.lease.write": "fail a job-lease publish (stage/fsync seam)",
+    "fleet.lease.reap": "fail an expired-lease reap steal mid-flight",
 }
 
 _DEFAULT_ACTION = {

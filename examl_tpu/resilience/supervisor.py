@@ -179,12 +179,15 @@ def checkpoint_glob(workdir: str, run_id: str) -> List[str]:
 
 def resume_evidence(workdir: str, run_id: str) -> List[str]:
     """Everything a retry can resume FROM: published checkpoints plus
-    the fleet results journal (fleet/quarantine.py — written per
-    finished job, so it can exist before the first checkpoint publishes
-    when a crash lands between a batch and its checkpoint; run_fleet
-    reconciles journal ∪ checkpoint under -R)."""
-    return checkpoint_glob(workdir, run_id) + sorted(glob.glob(
-        os.path.join(workdir, f"ExaML_fleetJournal.{run_id}")))
+    the fleet results journal(s) (fleet/quarantine.py — written per
+    finished job, so one can exist before the first checkpoint
+    publishes when a crash lands between a batch and its checkpoint;
+    run_fleet reconciles journal ∪ checkpoint under -R).  Leased gangs
+    write one journal per rank (`.r<k>` suffix)."""
+    return checkpoint_glob(workdir, run_id) + sorted(set(
+        glob.glob(os.path.join(workdir, f"ExaML_fleetJournal.{run_id}"))
+        + glob.glob(os.path.join(
+            workdir, f"ExaML_fleetJournal.{run_id}.r*"))))
 
 
 def _repo_env() -> Dict[str, str]:
@@ -687,12 +690,17 @@ class GangSupervisor(Supervisor):
 
     def __init__(self, argv: List[str], workdir: str, run_id: str,
                  ranks: int, emulate: bool = False, min_ranks: int = 1,
-                 **kwargs):
+                 fleet: bool = False, **kwargs):
         super().__init__(argv, workdir, run_id, **kwargs)
         self.world = max(1, int(ranks))
         self._max_world = self.world
         self.emulate = bool(emulate)
         self.min_ranks = max(1, int(min_ranks))
+        # Fleet gangs are NOT lockstep (ISSUE 14): every rank leases
+        # independent jobs from the shared board, so the failure domain
+        # is the RANK, not the gang — `run()` takes the leased loop
+        # (`_run_fleet`) instead of the lockstep kill-the-world policy.
+        self.fleet = bool(fleet)
         self._children: List[subprocess.Popen] = []
         self._death_streak = 0
         self._last_dead_rank: Optional[int] = None
@@ -871,9 +879,220 @@ class GangSupervisor(Supervisor):
                     return verdict, guilty, ex
             time.sleep(POLL_S)
 
+    # -- the leased fleet gang (non-lockstep rank domains) -------------------
+
+    def _spawn_fleet_rank(self, k: int, attempt: int) -> subprocess.Popen:
+        """One fleet rank, env-contract only: fleet ranks never join a
+        collective process group (jobs are independent), so even
+        non-emulated launches spawn plain single-process ranks with
+        EXAML_PROCID/EXAML_GANG_RANKS exported.  NO tier pins: a fleet
+        rank death indicts the rank's environment, never the program
+        tier."""
+        argv = self._last_argv = self._attempt_argv()
+        env = _repo_env()
+        env["EXAML_HEARTBEAT_FILE"] = self.hb_path
+        env["EXAML_RESTART_COUNT"] = str(attempt)
+        env[heartbeat.PROCID_VAR] = str(k)
+        env[heartbeat.GANG_VAR] = str(self.world)
+        if self._hang_attempts:
+            env["EXAML_FLEET_HANG_ATTEMPTS"] = ",".join(
+                f"{jid}={n}" for jid, n in sorted(
+                    self._hang_attempts.items()))
+        try:
+            os.unlink(heartbeat.rank_path(self.hb_path, k))
+        except OSError:
+            pass
+        self.log(f"fleet rank {k}: starting (attempt {attempt}) "
+                 + ("(resume -R) " if "-R" in argv else "")
+                 + " ".join(argv))
+        return subprocess.Popen(
+            [sys.executable, "-m", "examl_tpu.cli.main"] + argv,
+            env=env, start_new_session=True)
+
+    def _rank_fleet_deadline(self, k: int):
+        """(deadline, jobs) declared by rank k's last FLEET beat, or
+        (None, []) — the per-rank version of `_watch`'s in-flight
+        declaration read."""
+        rec = heartbeat.read(heartbeat.rank_path(self.hb_path, k)) or {}
+        fl = rec.get("fleet") or {}
+        if fl.get("jobs") and fl.get("deadline"):
+            try:
+                return float(fl["deadline"]), [str(j) for j in
+                                               fl["jobs"]]
+            except (TypeError, ValueError):
+                pass
+        return None, []
+
+    def _run_fleet(self) -> int:
+        """The leased-gang loop: rank-level fault domains.  A dead rank
+        costs ONLY its in-flight leases — the rank is restarted alone
+        (cause `fleet-rank-death`, no gang-wide kill, no tier pin, no
+        run-level retry), its expired leases are reaped by surviving
+        ranks, and a rank that keeps dying is eventually ABANDONED
+        while the rest of the gang serves on (the elastic-resume lesson
+        applied at the rank level)."""
+        prior = self._install_signals()
+        respawn_cap = max(5, 3 * self.max_retries)
+        children: Dict[int, subprocess.Popen] = {}
+        respawns: Dict[int, int] = {k: 0 for k in range(self.world)}
+        spawn_at: Dict[int, float] = {}
+        spawned_t: Dict[int, float] = {}
+        done: Dict[int, int] = {}
+        abandoned: set = set()
+        first_beat_deadline = (max(4.0 * self.stall_timeout, 900.0)
+                               if self.stall_timeout else float("inf"))
+        last_rc = 1
+
+        def rank_died(k: int, cause: str, rc) -> None:
+            nonlocal last_rc
+            last_rc = rc if rc is not None else 1
+            self._inc("resilience.gang.fleet_rank_deaths")
+            self._inc("resilience.restarts")
+            self._inc(f"resilience.gang.rank_exits.r{k}."
+                      f"{cause.replace('-', '_')}")
+            self.attempts.append({
+                "rank": k, "cause": exitcause.CAUSE_FLEET_RANK_DEATH,
+                "rank_cause": cause, "returncode": rc,
+                "respawn": respawns[k],
+                "seconds": round(time.time() - spawned_t.get(k, 0.0),
+                                 2)})
+            respawns[k] += 1
+            if respawns[k] > respawn_cap:
+                abandoned.add(k)
+                self._inc("resilience.gang.rank_abandoned")
+                _ledger.event("supervisor.rank_abandoned", rank=k,
+                              respawns=respawns[k] - 1)
+                self.log(f"fleet rank {k} died {respawns[k] - 1} "
+                         "time(s); ABANDONING the rank slot (its "
+                         "leases expire; peers absorb the queue)")
+                return
+            delay = backoff_delay(self.backoff, respawns[k],
+                                  key=f"{self.run_id}:r{k}")
+            spawn_at[k] = time.time() + delay
+            _ledger.event("supervisor.restart",
+                          cause=exitcause.CAUSE_FLEET_RANK_DEATH,
+                          rank=k, rank_cause=cause,
+                          retry_consumed=False,
+                          delay_s=round(delay, 2))
+            self.log(
+                f"fleet rank {k} died ({cause} "
+                f"{exitcause.exit_desc(rc, none_desc='(killed)')}); "
+                f"restarting ONLY this rank in {delay:.1f}s — "
+                "fleet-rank-death: its in-flight leases expire and "
+                "peers reap them (no gang kill, no tier pin, no "
+                "run-level retry)")
+
+        try:
+            for k in range(self.world):
+                children[k] = self._spawn_fleet_rank(k, 0)
+                spawned_t[k] = time.time()
+            while True:
+                self._children = [ch for k, ch in sorted(children.items())
+                                  if k not in done]
+                if self._preempt_signal is not None:
+                    self.log(f"supervisor preempted "
+                             f"({self._preempt_signal}); draining the "
+                             "fleet gang")
+                    self._inc("resilience.preempts")
+                    self._drain_gang()
+                    return exitcause.EXIT_PREEMPTED
+                for k in sorted(children):
+                    if k in done or k in abandoned:
+                        continue
+                    ch = children[k]
+                    if k in spawn_at:
+                        # waiting out the respawn backoff
+                        if time.time() >= spawn_at[k]:
+                            del spawn_at[k]
+                            children[k] = self._spawn_fleet_rank(
+                                k, respawns[k])
+                            spawned_t[k] = time.time()
+                        continue
+                    rc = ch.poll()
+                    if rc is not None:
+                        cause = exitcause.classify(rc)
+                        if cause == exitcause.CAUSE_OK:
+                            done[k] = 0
+                            self.log(f"fleet rank {k}: queue drained, "
+                                     "exited cleanly")
+                            continue
+                        _ledger.event("supervisor.kill",
+                                      reason="fleet-rank-death", rank=k,
+                                      cause=cause, returncode=rc)
+                        rank_died(k, cause, rc)
+                        continue
+                    # Per-rank liveness: a stalled or job-stuck rank is
+                    # killed ALONE (the peers are not blocked on it —
+                    # nothing is lockstep here) and restarted through
+                    # the same rank-death path.
+                    hb = heartbeat.rank_path(self.hb_path, k)
+                    hb_age = heartbeat.age(hb)
+                    deadline, jobs = self._rank_fleet_deadline(k)
+                    if deadline is not None and time.time() > deadline:
+                        for jid in jobs:
+                            self._hang_attempts[jid] = \
+                                self._hang_attempts.get(jid, 0) + 1
+                        self._inc("resilience.fleet_job_stuck_kills")
+                        _ledger.event("supervisor.kill",
+                                      reason="fleet-job-stuck", rank=k,
+                                      jobs=",".join(jobs))
+                        self.log(f"fleet rank {k}: batch blew its "
+                                 f"per-job deadline (jobs "
+                                 f"{','.join(jobs)}); killing and "
+                                 "restarting the rank (jobs pay "
+                                 "attempts, the run pays nothing)")
+                        self._kill_group(ch)
+                        rank_died(k, exitcause.CAUSE_FLEET_JOB_STUCK,
+                                  ch.returncode)
+                        continue
+                    if self.stall_timeout:
+                        stalled = (
+                            hb_age > self.stall_timeout
+                            if hb_age is not None else
+                            time.time() - spawned_t[k]
+                            > first_beat_deadline)
+                        if stalled and deadline is None:
+                            self._inc("resilience.heartbeat_stalls")
+                            _ledger.event("supervisor.kill",
+                                          reason="heartbeat-stall",
+                                          rank=k,
+                                          beat_age_s=(round(hb_age, 1)
+                                                      if hb_age
+                                                      is not None
+                                                      else None))
+                            self.log(f"fleet rank {k}: heartbeat "
+                                     "stalled; killing and restarting "
+                                     "the rank")
+                            self._kill_group(ch)
+                            rank_died(k, exitcause.CAUSE_HANG_KILL,
+                                      ch.returncode)
+                            continue
+                if len(done) + len(abandoned) >= self.world:
+                    break
+                time.sleep(POLL_S)
+            if done:
+                self.log(f"fleet gang completed: {len(done)} rank(s) "
+                         f"drained the queue"
+                         + (f", {len(abandoned)} abandoned"
+                            if abandoned else ""))
+                _ledger.event("supervisor.done", world=self.world,
+                              ranks_ok=len(done),
+                              ranks_abandoned=len(abandoned))
+                return 0
+            self.log("every fleet rank was abandoned; giving up")
+            return self._exhausted_rc(last_rc)
+        finally:
+            self._children = list(children.values())
+            self._kill_gang()
+            self._restore_signals(prior)
+            self._merge_metrics()
+            self._finalize_ledger()
+
     # -- the gang supervision loop ------------------------------------------
 
     def run(self) -> int:
+        if self.fleet:
+            return self._run_fleet()
         prior = self._install_signals()
         retries = 0
         preempts = 0
@@ -1019,13 +1238,19 @@ class GangSupervisor(Supervisor):
 def launch_gang(argv: List[str], args, log=print) -> int:
     """CLI entry for `--launch N`: spawn and supervise the whole gang.
     Like `supervise()`, this parent stays jax-free — every rank is a
-    killable child process group."""
+    killable child process group.  Fleet modes (-b/-N/--serve) get the
+    NON-LOCKSTEP leased-rank policy: a rank death restarts only that
+    rank (`fleet-rank-death`) instead of killing the world."""
     workdir = getattr(args, "workdir", ".") or "."
+    fleet = bool(getattr(args, "bootstrap", 0)
+                 or getattr(args, "multi_start", 0)
+                 or getattr(args, "serve", None))
     sup = GangSupervisor(
         argv, workdir=workdir, run_id=args.run_id,
         ranks=getattr(args, "launch", 1) or 1,
         emulate=getattr(args, "launch_emulate", False),
         min_ranks=getattr(args, "launch_min_ranks", 1),
+        fleet=fleet,
         max_retries=getattr(args, "supervise_retries", DEFAULT_RETRIES),
         stall_timeout=getattr(args, "supervise_stall", DEFAULT_STALL),
         backoff=getattr(args, "supervise_backoff", 2.0),

@@ -424,9 +424,10 @@ def test_serve_resume_snapshot_applies_once(tmp_path):
     jobs_file.write_text('{"kind": "start"}\n{"kind": "start"}\n')
     drv = FleetDriver(inst, batch_cap=4)
     dispatched = []
-    orig = drv._dispatch
-    drv._dispatch = lambda batch: (dispatched.extend(
-        j.job_id for j in batch), orig(batch))[1]
+    orig = drv._dispatch_round
+    drv._dispatch_round = lambda assignments: (dispatched.extend(
+        j.job_id for _, b in assignments for j in b),
+        orig(assignments))[1]
     # Stale snapshot: start0 done (sentinel lnl), start1 pending — as a
     # checkpoint taken before start1 finished would record.
     resume = {"fleet": {"jobs": [

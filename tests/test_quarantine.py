@@ -383,9 +383,10 @@ def test_driver_hang_suspects_quarantine_and_solo(monkeypatch):
                        "start0=2,start1=1")
     drv = FleetDriver(inst, batch_cap=8, policy=_fast_policy())
     dispatched = []
-    orig = drv._dispatch
-    drv._dispatch = lambda batch: (dispatched.append(
-        [j.job_id for j in batch]), orig(batch))[1]
+    orig = drv._dispatch_round
+    drv._dispatch_round = lambda assignments: (dispatched.extend(
+        [j.job_id for j in b] for _, b in assignments),
+        orig(assignments))[1]
     out = drv.run(make_jobs("start", 4, 7))
     by = {j.job_id: j for j in out}
     assert by["start0"].failed and by["start0"].cause == "hang"

@@ -60,9 +60,10 @@ SYNC_SEAMS = (
     ("examl_tpu/ops/engine.py", "whole_tree_gradients"),
     ("examl_tpu/fleet/batch.py", "_grad_batch"),
     # Fleet batched evaluation: per-job host lnL rows at the batch
-    # boundary feed the results table and the fsync'd journal.
-    ("examl_tpu/fleet/batch.py", "_eval_fast"),
-    ("examl_tpu/fleet/batch.py", "_eval_scan"),
+    # boundary feed the results table and the fsync'd journal.  The
+    # launch half (launch_eval / launch_universal) stays ASYNC so
+    # device lanes overlap; `collect` is the one blocking seam.
+    ("examl_tpu/fleet/batch.py", "collect"),
     # Batched quartet scoring returns host lnls for candidate selection
     # at the batch boundary (one sync per n_jobs-sized batch).
     ("examl_tpu/search/quartets_batch.py", "score_jobs"),

@@ -149,6 +149,13 @@ ENV_REGISTRY = {
         "doc": "readme",
         "note": "promote a recurring novel profile to the specialized "
                 "batched program after K jobs."},
+    "EXAML_FLEET_UNIBATCH": {
+        "doc": "readme",
+        "note": "1 batches mixed-profile novel jobs through the "
+                "vmapped select_n universal program (measured ~3x "
+                "per-step compute: a dispatch-bound-only win, so "
+                "default off; fleet.universal_retrace counts the "
+                "forgone batching)."},
     # -- bench harness -----------------------------------------------------
     "EXAML_BENCH_T0": {
         "doc": "registry",

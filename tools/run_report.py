@@ -184,6 +184,46 @@ def render_fleet(out, snap: dict, events: list) -> None:
     if fd:
         out("  fault domains              "
             + "  ".join(f"{label}={v}" for label, v in fd))
+    # Tree-axis device sharding (ISSUE 14): one evaluation lane per
+    # local device — per-lane dispatch counters plus the degraded-lane
+    # evidence (a lane that failed init, never an abort).
+    lanes = [(k.rsplit(".", 1)[-1], int(v))
+             for k, v in sorted(c.items())
+             if k.startswith("fleet.device_dispatches.")]
+    if lanes or g.get("fleet.devices"):
+        jobs_per = {k.rsplit(".", 1)[-1]: int(v)
+                    for k, v in c.items()
+                    if k.startswith("fleet.device_jobs.")}
+        out(f"  device lanes               "
+            f"{int(g.get('fleet.devices', len(lanes) or 1))}"
+            + (f"  degraded={int(c['fleet.device_degraded'])}"
+               if c.get("fleet.device_degraded") else "")
+            + ("  " + "  ".join(
+                f"{d}={n}({jobs_per.get(d, 0)}j)" for d, n in lanes)
+               if lanes else ""))
+    # Rank-level fault domain (leased gangs): lease traffic + the
+    # recovery evidence — reaped = a dead rank's in-flight jobs
+    # re-served; lost = completions fenced off (exactly-once guard);
+    # absorbed = peers' journaled results folded in.
+    lease = [(label, int(c.get(k, 0)))
+             for label, k in (("acquired", "fleet.leases_acquired"),
+                              ("reaped", "fleet.leases_reaped"),
+                              ("lost", "fleet.leases_lost"),
+                              ("errors", "fleet.lease_errors"),
+                              ("absorbed", "fleet.jobs_absorbed"))
+             if c.get(k)]
+    if lease:
+        out("  job leases                 "
+            + "  ".join(f"{label}={v}" for label, v in lease))
+    # Batched-universal serving (opt-in EXAML_FLEET_UNIBATCH=1):
+    # uni_batches = mixed-profile batches through the vmapped select_n
+    # program; universal_retrace = solo novel-profile dispatches a
+    # batched program would have merged (the re-measurement evidence).
+    if c.get("fleet.uni_batches") or c.get("fleet.universal_retrace"):
+        out("  batched universal          "
+            f"uni_batches={int(c.get('fleet.uni_batches', 0))}"
+            f"  universal_retrace="
+            f"{int(c.get('fleet.universal_retrace', 0))}")
     # Universal-interpreter serving: how many NOVEL profiles arrived
     # (each one would have been a silent first-call compile before the
     # topology-as-data tier) and how many dispatches the interpreter

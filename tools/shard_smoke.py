@@ -1,0 +1,179 @@
+#!/usr/bin/env python
+"""Multi-device fleet scaling smoke (ISSUE 14 / ROADMAP §8a).
+
+Runs the same multi-start job set through the fleet driver with ONE
+evaluation lane and with D device lanes (XLA forced host devices on
+CPU; real accelerators use their local device set), measures warm
+trees/s both ways, and emits the SHARD_BENCH artifact with the
+occupancy and per-device dispatch gauges the acceptance criterion
+names.
+
+Honesty discipline (the `vs_baseline_valid` pattern): forced host
+devices TIME-SHARE the host's cores, so the achievable scaling ceiling
+is `min(D, cpus)` — a 1-core container cannot show 4x no matter how
+correct the sharding is.  The artifact records both the raw `0.7*D`
+acceptance target and the core-capped effective target actually
+assertable on this host, plus the cpu count, so a chip round (or any
+multi-core runner) re-derives the real verdict from the same tool.
+
+    python tools/shard_smoke.py                     # CI smoke
+    python tools/shard_smoke.py --devices 4 --jobs 32 --out SHARD_BENCH.json
+
+Exit 0 = evidence present and the core-capped target met; 1 otherwise.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def _force_devices(n: int) -> None:
+    """Force n XLA host devices — must run before jax imports."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n}"
+        ).strip()
+
+
+def _cpus() -> int:
+    try:
+        return len(os.sched_getaffinity(0))
+    except (AttributeError, OSError):
+        return os.cpu_count() or 1
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--devices", type=int, default=4)
+    ap.add_argument("--jobs", type=int, default=24)
+    ap.add_argument("--ntaxa", type=int, default=24)
+    ap.add_argument("--nsites", type=int, default=600)
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--out", default=None)
+    ap.add_argument("--require-scaling", type=float, default=None,
+                    help="override the asserted scaling floor "
+                         "(default: 0.7 * min(devices, cpus))")
+    args = ap.parse_args(argv)
+
+    _force_devices(args.devices)
+    import numpy as np
+
+    from examl_tpu import obs
+    from examl_tpu.fleet.driver import FleetDriver
+    from examl_tpu.fleet.jobs import make_jobs
+    from examl_tpu.instance import PhyloInstance
+    from examl_tpu.io.alignment import build_alignment_data
+
+    rng = np.random.default_rng(7)
+    cur = rng.integers(0, 4, args.nsites)
+    seqs = []
+    for _ in range(args.ntaxa):
+        flip = rng.random(args.nsites) < 0.15
+        cur = np.where(flip, rng.integers(0, 4, args.nsites), cur)
+        seqs.append("".join("ACGT"[c] for c in cur))
+    data = build_alignment_data(
+        [f"t{i}" for i in range(args.ntaxa)], seqs)
+
+    def measure(devices: int):
+        inst = PhyloInstance(data)
+        drv = FleetDriver(inst, batch_cap=args.batch, devices=devices)
+        lanes = len(drv.shards) if drv.shards is not None else 1
+        # Warm-up pass: per-lane/per-device program compiles happen
+        # here, not inside the timed pass.
+        drv.run(make_jobs("start", args.jobs, 11))
+        drv2 = FleetDriver(inst, batch_cap=args.batch, devices=devices)
+        jobs = make_jobs("start", args.jobs, 11)
+        t0 = time.perf_counter()
+        out = drv2.run(jobs)
+        wall = time.perf_counter() - t0
+        bad = [(j.job_id, j.cause) for j in out if not j.done or j.failed]
+        assert not bad, f"jobs failed: {bad}"
+        lnls = {j.job_id: j.lnl for j in out}
+        return lanes, args.jobs / wall, wall, lnls
+
+    obs.reset()
+    lanes1, tps1, wall1, lnl1 = measure(1)
+    lanes_d, tps_d, wall_d, lnl_d = measure(args.devices)
+    assert lnl1 == lnl_d, "placement-dependent lnL: parity broken"
+
+    snap = obs.snapshot()
+    counters = snap.get("counters", {})
+    gauges = snap.get("gauges", {})
+    per_device = {k: v for k, v in counters.items()
+                  if k.startswith("fleet.device_")}
+    occupancy = gauges.get("fleet.batch_occupancy")
+    cpus = _cpus()
+    scaling = tps_d / tps1 if tps1 else 0.0
+    effective = min(args.devices, cpus)
+    target_raw = 0.7 * args.devices
+    target = (args.require_scaling if args.require_scaling is not None
+              else 0.7 * effective)
+
+    artifact = {
+        "bench": "shard",
+        "backend": "cpu-forced-host-devices",
+        "devices_requested": args.devices,
+        "lanes_initialized": lanes_d,
+        "cpus": cpus,
+        "jobs": args.jobs,
+        "ntaxa": args.ntaxa,
+        "nsites": args.nsites,
+        "trees_per_sec_single": round(tps1, 3),
+        "trees_per_sec_sharded": round(tps_d, 3),
+        "wall_single_s": round(wall1, 3),
+        "wall_sharded_s": round(wall_d, 3),
+        "scaling_x": round(scaling, 3),
+        "target_raw_0p7xD": round(target_raw, 3),
+        "target_effective": round(target, 3),
+        "effective_parallelism_cap": effective,
+        "meets_target_raw": scaling >= target_raw,
+        "meets_target": scaling >= target,
+        "lnl_parity": "bit-identical",
+        "occupancy": occupancy,
+        "per_device_counters": per_device,
+        "device_degraded": counters.get("fleet.device_degraded", 0),
+        "note": ("forced host devices time-share the cores: the "
+                 "assertable ceiling is min(D, cpus); re-run on a "
+                 "multi-core/chip host for the raw 0.7*D verdict"),
+    }
+    print(json.dumps(artifact, indent=2, sort_keys=True))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(artifact, f, indent=2, sort_keys=True)
+        print(f"shard bench row -> {args.out}")
+
+    ok = True
+    if lanes_d < min(args.devices, 2):
+        print(f"FAIL: only {lanes_d} lane(s) initialized")
+        ok = False
+    if occupancy is None:
+        print("FAIL: no fleet.batch_occupancy gauge recorded")
+        ok = False
+    lanes_used = sum(1 for k in per_device
+                     if k.startswith("fleet.device_dispatches."))
+    if lanes_used < lanes_d:
+        print(f"FAIL: only {lanes_used} of {lanes_d} lanes dispatched")
+        ok = False
+    if scaling < target:
+        print(f"FAIL: scaling {scaling:.2f}x < effective target "
+              f"{target:.2f}x (cpus={cpus})")
+        ok = False
+    print(("OK" if ok else "FAILED")
+          + f": {lanes_d} lanes, {scaling:.2f}x vs effective target "
+          f"{target:.2f}x (raw 0.7*D={target_raw:.2f}x, cpus={cpus})")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -138,8 +138,14 @@ def render_frame(out, workdir: str, beats: list, metrics_path,
                                  ("prof_miss", "fleet.profile_misses"),
                                  ("grad", "engine.grad_pass_dispatches"),
                                  ("grad_sweeps",
-                                  "fleet.grad_smooth_sweeps"))
+                                  "fleet.grad_smooth_sweeps"),
+                                 ("leased", "fleet.leases_acquired"),
+                                 ("reaped", "fleet.leases_reaped"),
+                                 ("absorbed", "fleet.jobs_absorbed"),
+                                 ("dev_degr", "fleet.device_degraded"))
                 if counters.get(k))
+            if gauges.get("fleet.devices", 0) > 1:
+                fd += f"  lanes={int(gauges['fleet.devices'])}"
             out(f"  fleet{tag}: "
                 f"queue={int(gauges.get('fleet.queue_depth', 0))}  "
                 f"done={int(gauges.get('fleet.jobs_done', 0))}"
