@@ -1178,7 +1178,9 @@ def main(argv=None) -> int:
     # heartbeat stream).
     obs.reset()
     from examl_tpu.ops import bank as _bank
+    from examl_tpu.ops import export_bank as _export_bank
     _bank.reset()
+    _export_bank.reset()
     _faults.reset()
     _heartbeat.reset()
     prior_faults_env = os.environ.get(_faults.ENV_VAR)
@@ -1340,6 +1342,18 @@ def _run(args, files: RunFiles) -> int:
     files.info(f"alignment: {args.bytefile}  mode: -f {args.mode}  "
                f"model: {args.model}")
 
+    # Validate EXAML_EXPORT_BANK ONCE, before the bank phase: a typo'd
+    # opt-in must fail here in seconds, not as a per-worker engine
+    # error minutes into banking (enabled()/family_coverage swallow
+    # the ValueError by design — they run in seams that must not
+    # crash).
+    from examl_tpu.ops import export_bank as _eb
+    try:
+        _eb.mode()
+    except ValueError as exc:
+        files.info(f"ERROR: {exc}")
+        return 1
+
     bank_report = None
     if getattr(args, "bank", False):
         # Ahead-of-time program banking, BEFORE this process touches
@@ -1361,6 +1375,13 @@ def _run(args, files: RunFiles) -> int:
         cache = enable_persistent_compilation_cache()
         if cache:
             files.info(f"persistent compile cache: {cache}")
+        from examl_tpu.ops import export_bank
+        if export_bank.enabled():
+            # Zero-compile restart path (ops/export_bank.py): engines
+            # built below resolve exported-artifact -> persistent-XLA-
+            # cache -> fresh-compile per program; a restarted or cold
+            # process reaches its first dispatch without compiling.
+            files.info(export_bank.startup_info())
         sharding = select_sharding(args, args.save_memory, log=files.info)
         # Multi-process jobs read only their own site columns (the
         # reference's readMyData) — policy in selective_read_decision.

@@ -22,6 +22,16 @@ evaluation (the parity contract tests/test_fleet.py pins bit-for-bit):
 Job counts pad to a power of two (padding jobs replay job 0, results
 discarded) so compiled variants stay O(log J) and the real/padded
 ratio is the `fleet.batch_occupancy` evidence.
+
+Every batched program here enters the engine's shared cache through
+`cache_put`, which routes it through the exported program bank
+(ops/export_bank.py) when EXAML_EXPORT_BANK is on: a respawned fleet
+rank or autoscaled replica deserializes its fleet/fleetscan/fleetw/
+fleetgrad executables instead of recompiling them, so rank-respawn
+MTTR is the lease re-dispatch, not the compile phase (the jit keys
+below are tuples of primitives — profile, bucketed shapes, pad counts
+— which is what makes the artifact signatures stable across
+processes).
 """
 
 from __future__ import annotations

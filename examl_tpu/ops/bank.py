@@ -683,6 +683,38 @@ def run_bank(args, log=lambda msg: None, timeout: Optional[float] = None,
                                                       False))
     obs.inc("bank.families", len(families))
     report: Dict[str, dict] = {}
+    # Exported program bank (ops/export_bank.py): families a cold
+    # restart will DESERIALIZE need no subprocess compile worker — this
+    # is what turns a supervised retry or autoscaled cold start from
+    # "full bank phase" into "load ladder".  Coverage checks only the
+    # backend-independent stamps here (this runs before the parent may
+    # touch its backend); an artifact whose platform later disagrees
+    # costs a counted fall-through to the watchdogged in-process
+    # compile, never a wrong result.
+    from examl_tpu.ops import export_bank
+    all_families = list(families)
+    if export_bank.enabled():
+        # Dataset guard for the worker skip: artifact loadability is
+        # SIGNATURE-level (avals), so another dataset's same-named
+        # artifacts must not skip this run's compile workers only to
+        # miss at warm time.  ntaxa reads from the byteFile header —
+        # no backend touch, honoring the bank's ordering contract.
+        ntaxa = None
+        try:
+            from examl_tpu.io.bytefile import read_bytefile_meta
+            ntaxa = read_bytefile_meta(args.bytefile).ntaxa
+        except Exception:                     # noqa: BLE001 — raw
+            pass                              # PHYLIP input: no filter
+        cover = export_bank.family_coverage(families, ntaxa=ntaxa)
+        for fam in cover:
+            report[fam] = {"status": "exported",
+                           "artifacts": cover[fam]}
+        if cover:
+            obs.inc("bank.exported_families", len(cover))
+            log(f"bank: {len(cover)} of {len(families)} families "
+                "covered by exported artifacts; their compile workers "
+                "are skipped (" + ", ".join(sorted(cover)) + ")")
+        families = [f for f in families if f not in cover]
     spec_fd, spec_path = tempfile.mkstemp(suffix=".json",
                                           prefix="examl_bank_")
     with os.fdopen(spec_fd, "w") as f:
@@ -693,9 +725,13 @@ def run_bank(args, log=lambda msg: None, timeout: Optional[float] = None,
     nw = workers or _default_workers()
     nw = max(1, min(nw, len(families)))
     plans = [families[i::nw] for i in range(nw)]
-    log(f"banking {len(families)} program families in {nw} compile "
-        f"worker(s), {timeout:.0f}s/family deadline: "
-        + ", ".join(families))
+    if families:
+        log(f"banking {len(families)} program families in {nw} compile "
+            f"worker(s), {timeout:.0f}s/family deadline: "
+            + ", ".join(families))
+    else:
+        log("banking: every enumerated family is served by the "
+            "exported bank; no compile workers spawned")
 
     def merge_results(w):
         report.update({k: v for k, v in w.results.items()
@@ -815,12 +851,15 @@ def run_bank(args, log=lambda msg: None, timeout: Optional[float] = None,
         except OSError:
             pass
     obs.observe("bank.wall_seconds", time.perf_counter() - t_bank)
-    if cache_path is None:
+    if cache_path is None and families:
         # Without a persistent cache the workers' compiles are NOT
         # durable: the main-process warm pass will re-compile cold
         # (in-process, watchdogged).  The kill+degrade protection for
         # wedged families still stands — that is subprocess-side — but
-        # say loudly that the compile-time transfer is lost.
+        # say loudly that the compile-time transfer is lost.  (A run
+        # whose every family is exported-covered spawned no worker and
+        # learned no cache path — that is the zero-compile fast path,
+        # not a missing cache.)
         obs.inc("bank.no_cache")
         log("bank: persistent compile cache unavailable (no host "
             "fingerprint, or EXAML_COMPILE_CACHE=0) — worker compiles "
@@ -841,9 +880,13 @@ def run_bank(args, log=lambda msg: None, timeout: Optional[float] = None,
             log(f"bank: {fam} FAILED ({r.get('error', '?')})")
     _apply_degradations(report, log)
     _STATE["active"] = True
+    # Exported families join the banked set: if a rejected artifact
+    # later forces a guarded in-process compile, that first call is a
+    # member of a family the bank DID provision (first_calls.banked),
+    # not an enumeration gap.
     _STATE["banked"] = {f for f, r in report.items()
-                        if r.get("status") == "banked"}
-    _STATE["enumerated"] = set(families)
+                        if r.get("status") in ("banked", "exported")}
+    _STATE["enumerated"] = set(all_families)
     world = _world_size()
     if world > 1:
         # ROADMAP §4 observability: workers cannot join this job's
@@ -938,18 +981,42 @@ def _save_manifest(cache_path: Optional[str], report: Dict[str, dict],
     if not cache_path:
         return
     path = os.path.join(cache_path, MANIFEST_NAME)
-    families = dict((load_manifest(cache_path) or {}).get("families")
-                    or {})
-    families.update(report)
+    # Same advisory flock as export_bank._update_exports: leased fleet
+    # ranks (and that module's own export writes) share this file, and
+    # an unlocked read-modify-write here could overwrite a concurrent
+    # rank's freshly-recorded export entries with a stale read.
+    lock_fd = None
     try:
-        with open(path, "w") as f:
-            json.dump({"version": 1, "updated": time.time(),
-                       "chunk_layout": chunk_layout_info(),
-                       "families": families}, f, indent=2,
-                      sort_keys=True)
-        log(f"bank manifest -> {path}")
-    except OSError as exc:
-        log(f"bank manifest not written ({exc})")
+        try:
+            import fcntl
+            lock_fd = os.open(path + ".lock",
+                              os.O_CREAT | os.O_RDWR, 0o644)
+            fcntl.flock(lock_fd, fcntl.LOCK_EX)
+        except Exception:                     # noqa: BLE001 — advisory
+            lock_fd = None
+        prior = load_manifest(cache_path) or {}
+        families = dict(prior.get("families") or {})
+        families.update(report)
+        doc = {"version": 1, "updated": time.time(),
+               "chunk_layout": chunk_layout_info(),
+               "families": families}
+        if prior.get("exports"):
+            # The exported-artifact index (ops/export_bank.py) shares
+            # this manifest: a banking pass must never erase the
+            # records a cold restart's load ladder depends on.
+            doc["exports"] = prior["exports"]
+        try:
+            with open(path, "w") as f:
+                json.dump(doc, f, indent=2, sort_keys=True)
+            log(f"bank manifest -> {path}")
+        except OSError as exc:
+            log(f"bank manifest not written ({exc})")
+    finally:
+        if lock_fd is not None:
+            try:
+                os.close(lock_fd)             # releases the flock
+            except OSError:
+                pass
 
 
 def load_manifest(cache_path: Optional[str] = None) -> Optional[dict]:
@@ -989,11 +1056,18 @@ def warm_instance(inst, tree, report: Dict[str, dict], log) -> None:
     are disk-cache hits, so the engine's `_guard_first_call` fires — and
     its compile counters accrue — here rather than mid-search.  A warm
     failure only forfeits the warm (the family recompiles lazily,
-    watchdogged, like before banking existed)."""
+    watchdogged, like before banking existed).
+
+    Families with status "exported" warm through the export-bank load
+    ladder instead: their first calls DESERIALIZE (ops/export_bank.py
+    — `bank.export.hits`, no compile, no guard), and any rejected
+    artifact falls through to the persistent-cache/compile rung right
+    here in the bank phase rather than mid-search."""
     _STATE["in_phase"] = True
     try:
         for fam in [f for f in report
-                    if report[f].get("status") == "banked"]:
+                    if report[f].get("status") in ("banked",
+                                                   "exported")]:
             if _applicability(inst, fam) is not None:
                 continue
             try:

@@ -377,10 +377,32 @@ class LikelihoodEngine:
             self._jit_sumtable = jax.jit(self._sumtable_impl)
             self._jit_derivs = jax.jit(self._derivs_impl)
         self._jit_rate_scan = jax.jit(self._rate_scan_impl)
+        # Exported program bank (ops/export_bank.py): program-identity
+        # constants that are INVISIBLE in the arg avals — two programs
+        # with identical input shapes but different engine constants
+        # (scale exponent, dot precision, partition count, chunk-layout
+        # knobs) must never share a serialized executable.  Eligibility
+        # is single-process default-device engines only: mesh-sharded
+        # and -S pooled executables embed placement state the bank does
+        # not relocate (ROADMAP §4 keeps counting that residual).
+        self._export_identity = (
+            "prog-v1", self.K, str(self.dtype), str(self.storage_dtype),
+            int(self.scale_exp), str(self.fast_precision),
+            self.num_parts, self.num_branch_slots, self.ntips,
+            bool(self.psr), _fastpath._knobs(), self.wave_width)
+        self._exportable = (self.sharding is None and not save_memory
+                            and self.clv is not None
+                            and next(iter(self.clv.devices()))
+                            == jax.devices()[0])
         # Core programs get the same timed/watchdogged first-call monitor
         # as the shared-cache fast programs: any program family's compile
         # can wedge the remote tunnel, so every family must be able to
         # name itself from the watchdog and account its compile seconds.
+        # The export-bank wrapper sits OUTSIDE the guard: a deserialized
+        # executable serves the dispatch without the guard (or any
+        # compile) ever firing, a miss falls through to the guarded
+        # compile and serializes its result for the next cold start.
+        from examl_tpu.ops import export_bank as _export_bank
         for attr, family in (("_jit_traverse", "traverse"),
                              ("_jit_evaluate", "evaluate"),
                              ("_jit_trav_eval", "trav_eval"),
@@ -388,8 +410,12 @@ class LikelihoodEngine:
                              ("_jit_sumtable", "sumtable"),
                              ("_jit_derivs", "derivs"),
                              ("_jit_rate_scan", "rate_scan")):
-            setattr(self, attr, self._guard_first_call(getattr(self, attr),
-                                                       family))
+            raw = getattr(self, attr)
+            guarded = self._guard_first_call(raw, family)
+            setattr(self, attr, _export_bank.wrap(
+                raw, guarded, family, (family,) + self._export_identity,
+                exportable=self._exportable,
+                entry_meta={"ntips": self.ntips}))
         # In-engine traffic accounting (obs/traffic.py, the shared
         # roofline model): true (unpadded) pattern count for the bytes
         # model, per-tier windowed achieved-GB/s accumulators fed by
@@ -1027,7 +1053,20 @@ class LikelihoodEngine:
         return fn
 
     def cache_put(self, key, fn):
-        fn = self._guard_first_call(fn, self._cache_family(key))
+        # Guard, then export-wrap: an exported-bank hit serves the
+        # dispatch from a deserialized executable (the guard — and the
+        # compile it monitors — never fires); a miss runs the guarded
+        # compile and serializes it for the next cold start.  The cache
+        # key rides into the artifact signature: two programs with
+        # identical avals but different static closures (chunk profile,
+        # bucket pair) must never share an artifact.
+        from examl_tpu.ops import export_bank
+        family = self._cache_family(key)
+        guarded = self._guard_first_call(fn, family)
+        fn = export_bank.wrap(fn, guarded, family,
+                              (key,) + self._export_identity,
+                              exportable=self._exportable,
+                              entry_meta={"ntips": self.ntips})
         self._fast_jit_cache[key] = fn
         while len(self._fast_jit_cache) > self._fast_jit_cache_cap:
             self._fast_jit_cache.popitem(last=False)

@@ -32,7 +32,10 @@ derivatives are built from, so the outroot pass rescales VALUES (same
 threshold/multiplier as newview) but tracks no counts.
 
 Shapes are bucketed (`bucket_len`/`next_pow2`) so the jitted gradient
-program — keyed ("grad", L, W, n_chunks) — is a tiny closed family
+program — keyed ("grad", L, W, n_chunks), and therefore eligible for
+the exported program bank (ops/export_bank.py: a restart deserializes
+the compiled gradient pass instead of recompiling it) — is a tiny
+closed family
 shared across topologies, like the scan tier: topology ships as data.
 """
 

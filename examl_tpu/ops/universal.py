@@ -40,7 +40,13 @@ feed the schedule in as data):
 The jit key collapses from the per-topology segment profile to
 `("universal", (floor, cap), table_bucket, slot_bucket, with_eval)` — a
 tiny CLOSED family — so any topology of any size runs through an
-already-banked executable with zero first-call compiles.  Dispatch
+already-banked executable with zero first-call compiles.  That closure
+is also what makes the family SERIALIZABLE: the exported program bank
+(ops/export_bank.py) persists each bucket pair's compiled executable
+next to the XLA cache, so a restarted or autoscaled process
+deserializes the interpreter instead of compiling it — the
+zero-compile property extends from "within one process" to "across
+process lifetimes".  Dispatch
 reuses any already-compiled bucket pair that fits (`pick_pads`,
 mirroring the fleet tier's smallest-compiled-pow2 discipline), so a
 serving process never compiles again after warmup.  The price is

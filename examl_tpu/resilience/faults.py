@@ -32,6 +32,8 @@ Registered points (seam → default action):
     compile.hang       engine._guard_first_call first call    → hang
     checkpoint.write   CheckpointManager.write, pre-publish   → raise
     bank.worker        ops/bank worker, at family start       → signal KILL
+    bank.export.write  export_bank.export, pre-serialize      → raise
+    bank.export.load   export_bank load ladder, pre-read      → raise
     search.kill        heartbeat.beat (per search iteration)  → signal KILL
     heartbeat.stall    heartbeat.beat, sticky beat suppressor → flag
     fleet.dispatch     fleet driver, before a batch dispatch  → raise
@@ -72,6 +74,12 @@ POINTS = {
     "checkpoint.publish": "fail/kill between a fully-staged gang "
                           "checkpoint cycle and its publish rename",
     "bank.worker": "kill/hang a bank compile worker at family start",
+    "bank.export.write": "fail an exported-artifact serialize/publish "
+                         "(survivable: the run keeps its compiled "
+                         "program, only the artifact is lost)",
+    "bank.export.load": "fail an exported-artifact load (survivable: "
+                        "the ladder falls through to the persistent "
+                        "cache / fresh compile)",
     "search.kill": "signal self at the Nth search-loop heartbeat",
     "heartbeat.stall": "stop emitting heartbeats (sticky)",
     "fleet.dispatch": "raise at the fleet batched-dispatch boundary",

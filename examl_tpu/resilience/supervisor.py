@@ -24,6 +24,13 @@ plugin must not be able to hang the watcher).  It:
   (`EXAML_FAST_TRAVERSAL=0`, `EXAML_UNIVERSAL=0`,
   `EXAML_BATCH_SCAN=0`, `EXAML_BATCH_THOROUGH=0`) — the one tier
   hardware-proven everywhere;
+* advertises the exported program bank (ops/export_bank.py) to every
+  respawned child via EXAML_EXPORT_BANK passthrough: a retry's load
+  ladder deserializes executables instead of recompiling, so restart
+  MTTR is the failure, not the bank phase.  "Exported bank unusable"
+  is NOT a failure cause in this ladder — the child degrades to its
+  normal bank/compile phase in-process with `bank.export.rejected.*`
+  counters carrying the evidence;
 * treats a child exit of EXIT_PREEMPTED (75) as RESUMABLE: restarted
   immediately, no retry consumed (capped separately so a preemption
   storm still terminates);
@@ -339,6 +346,19 @@ class Supervisor:
                 f"{jid}={n}" for jid, n in sorted(
                     self._hang_attempts.items()))
         env.update(self._pins())
+        if restarts_total and (env.get("EXAML_EXPORT_BANK") or "") \
+                .strip().lower() not in ("", "0", "off", "no"):
+            # Zero-compile restart (ops/export_bank.py): the exported
+            # program bank rides the environment into every respawned
+            # child, whose load ladder deserializes executables instead
+            # of re-running the bank/warm compile phase — MTTR is the
+            # failure, not the recompilation.  An unusable exported
+            # bank is a counter-carrying downgrade to the normal bank
+            # phase inside the child (bank.export.rejected.*), never a
+            # distinct exit cause this ladder reacts to.
+            self.log("attempt %d: exported program bank advertised "
+                     "(EXAML_EXPORT_BANK=%s)"
+                     % (restarts_total, env["EXAML_EXPORT_BANK"]))
         argv = self._last_argv = self._attempt_argv()
         pins = self._pins()
         self.log(f"attempt {restarts_total}: starting "
@@ -887,7 +907,10 @@ class GangSupervisor(Supervisor):
         non-emulated launches spawn plain single-process ranks with
         EXAML_PROCID/EXAML_GANG_RANKS exported.  NO tier pins: a fleet
         rank death indicts the rank's environment, never the program
-        tier."""
+        tier.  EXAML_EXPORT_BANK rides `_repo_env`'s passthrough, so a
+        respawned rank deserializes its programs from the exported bank
+        (ops/export_bank.py) and re-leases its first job without paying
+        the compile phase that used to dominate rank-respawn MTTR."""
         argv = self._last_argv = self._attempt_argv()
         env = _repo_env()
         env["EXAML_HEARTBEAT_FILE"] = self.hb_path

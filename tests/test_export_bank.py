@@ -1,0 +1,492 @@
+"""AOT-exported program bank (examl_tpu/ops/export_bank.py): the
+fallback-not-crash load ladder, the corrupt-artifact rejection matrix
+with quarantine semantics, the `bank.export.*` fault points, and the
+zero-compile cold-start/restart integration with the CLI and `--bank`
+(run 2 of an identical run serves its first result with
+`engine.compile_count == 0` and `bank.export.hits > 0`)."""
+
+import hashlib
+import json
+import os
+import pickle
+import types
+
+import numpy as np
+import pytest
+
+from tests.conftest import correlated_dna
+
+from examl_tpu import config, obs
+from examl_tpu.ops import bank, export_bank
+from examl_tpu.resilience import faults
+
+
+# ---------------------------------------------------------------------------
+# fixtures / helpers
+
+
+@pytest.fixture
+def export_env(tmp_path, monkeypatch):
+    """Isolated persistent cache + export bank ON; restores the real
+    cache config afterwards (follows test_bank.py's isolation pattern:
+    artifacts and manifests must never land in the real user cache)."""
+    monkeypatch.setenv("EXAML_COMPILE_CACHE", str(tmp_path / "xla"))
+    monkeypatch.setenv("EXAML_EXPORT_BANK", "on")
+    cache = config.enable_persistent_compilation_cache()
+    assert cache, "persistent cache must enable for export-bank tests"
+    export_bank.reset()
+    faults.reset()
+    obs.reset()
+    yield cache
+    export_bank.reset()
+    monkeypatch.delenv("EXAML_COMPILE_CACHE", raising=False)
+    monkeypatch.delenv("EXAML_EXPORT_BANK", raising=False)
+    config.enable_persistent_compilation_cache()     # re-point jax
+
+
+def _toy_program():
+    """A small donating jit program with the same shape of seams the
+    engine programs have (scan + dot + donated carry)."""
+    import jax
+    import jax.numpy as jnp
+
+    def impl(x, y):
+        def body(c, _):
+            return c @ y + 1.0, None
+        c, _ = jax.lax.scan(body, x, None, length=4)
+        return c, c.sum()
+
+    raw = jax.jit(impl, donate_argnums=(0,))
+    x = jnp.ones((16, 16))
+    y = jnp.eye(16) * 0.5
+    return raw, x, y
+
+
+def _boom(*args):
+    raise AssertionError("fallback dispatched — the exported artifact "
+                         "was not served")
+
+
+def _populate(static_key=("toy", 0)):
+    """Export one toy artifact via the real miss path; returns the
+    expected result and the artifact signature."""
+    import jax.numpy as jnp
+    raw, x, y = _toy_program()
+    wrapped = export_bank.wrap(raw, raw, "toy", static_key)
+    out = wrapped(jnp.array(np.asarray(x)), y)
+    exports = export_bank.read_exports()
+    assert len(exports) == 1, exports
+    (sig, entry), = exports.items()
+    return np.asarray(out[0]), float(out[1]), sig, entry
+
+
+# ---------------------------------------------------------------------------
+# mode / signature units
+
+
+def test_mode_parsing(monkeypatch):
+    for v, want in (("", "off"), ("0", "off"), ("off", "off"),
+                    ("1", "on"), ("on", "on"), ("require", "require")):
+        monkeypatch.setenv(export_bank.ENV_VAR, v)
+        assert export_bank.mode() == want
+    monkeypatch.setenv(export_bank.ENV_VAR, "frobnicate")
+    with pytest.raises(ValueError):
+        export_bank.mode()
+    monkeypatch.delenv(export_bank.ENV_VAR, raising=False)
+    assert export_bank.mode() == "off"                # opt-in default
+
+
+def test_wrap_off_mode_returns_fallback_unchanged(monkeypatch):
+    monkeypatch.delenv(export_bank.ENV_VAR, raising=False)
+    raw, _, _ = _toy_program()
+    sentinel = object()
+    assert export_bank.wrap(raw, sentinel, "toy", ("k",)) is sentinel
+    # Ineligible programs bypass the bank even when it is on.
+    monkeypatch.setenv(export_bank.ENV_VAR, "on")
+    assert export_bank.wrap(raw, sentinel, "toy", ("k",),
+                            exportable=False) is sentinel
+
+
+def test_signature_is_stable_and_key_sensitive():
+    import jax.numpy as jnp
+    args = (jnp.ones((4, 2)), None, 3)
+    rkey = export_bank._route_key(args)
+    rkey2 = export_bank._route_key((jnp.zeros((4, 2)), None, 7))
+    assert rkey == rkey2                     # avals, not values
+    assert export_bank.signature("k1", rkey) == \
+        export_bank.signature("k1", rkey2)
+    assert export_bank.signature("k1", rkey) != \
+        export_bank.signature("k2", rkey)    # static key disambiguates
+    rkey3 = export_bank._route_key((jnp.ones((4, 3)), None, 3))
+    assert export_bank.signature("k1", rkey) != \
+        export_bank.signature("k1", rkey3)   # shape disambiguates
+
+
+# ---------------------------------------------------------------------------
+# export -> load round trip
+
+
+def test_roundtrip_export_then_load(export_env):
+    import jax.numpy as jnp
+    ref_arr, ref_sum, sig, entry = _populate()
+    c = obs.snapshot_counters()
+    assert c["bank.export.misses"] == 1
+    assert c["bank.export.writes"] == 1
+    assert c.get("bank.export.write_errors", 0) == 0
+    d = export_bank.bank_dir()
+    path = os.path.join(d, entry["file"])
+    assert os.path.exists(path)
+    assert entry["digest"] == hashlib.sha256(
+        open(path, "rb").read()).hexdigest()
+    assert entry["abi"] == export_bank.EXPORT_ABI
+    import jax
+    import jaxlib
+    assert entry["jax"] == jax.__version__
+    assert entry["jaxlib"] == jaxlib.__version__
+
+    # Cold process emulation: memos dropped, fresh jit object, a
+    # fallback that EXPLODES if dispatched — the artifact must serve.
+    export_bank.reset()
+    obs.reset()
+    raw, x, y = _toy_program()
+    wrapped = export_bank.wrap(raw, _boom, "toy", ("toy", 0))
+    out = wrapped(jnp.array(np.asarray(x)), y)
+    assert float(out[1]) == ref_sum
+    np.testing.assert_array_equal(np.asarray(out[0]), ref_arr)
+    c = obs.snapshot_counters()
+    assert c["bank.export.hits"] == 1
+    assert c.get("bank.export.misses", 0) == 0
+    # Second call reuses the installed route (no second load).
+    out2 = wrapped(jnp.array(np.asarray(x)), y)
+    assert float(out2[1]) == ref_sum
+    assert obs.snapshot_counters()["bank.export.hits"] == 1
+    t = obs.snapshot()["timers"].get("bank.export_load_seconds")
+    assert t and t["count"] == 1
+
+
+def test_require_mode_serves_hits_and_raises_on_miss(export_env,
+                                                    monkeypatch):
+    import jax.numpy as jnp
+    _, ref_sum, _, _ = _populate()
+    export_bank.reset()
+    monkeypatch.setenv(export_bank.ENV_VAR, "require")
+    raw, x, y = _toy_program()
+    wrapped = export_bank.wrap(raw, _boom, "toy", ("toy", 0))
+    assert float(wrapped(jnp.array(np.asarray(x)), y)[1]) == ref_sum
+    # A signature with no artifact must hard-fail, not silently compile.
+    other = export_bank.wrap(raw, raw, "toy", ("toy", "novel"))
+    with pytest.raises(export_bank.ExportBankRequired):
+        other(jnp.array(np.asarray(x)), y)
+
+
+# ---------------------------------------------------------------------------
+# corrupt-artifact matrix: every failure mode degrades with the right
+# counter, quarantines, and the run still completes
+
+
+def _mutate_manifest(sig, **fields):
+    path = export_bank._manifest_path()
+    doc = json.load(open(path))
+    doc["exports"][sig].update(fields)
+    with open(path, "w") as f:
+        json.dump(doc, f)
+
+
+def _reload_after_corruption():
+    """Fresh wrapper + memo reset; returns (result_sum, counters)."""
+    import jax.numpy as jnp
+    export_bank.reset()
+    obs.reset()
+    raw, x, y = _toy_program()
+    wrapped = export_bank.wrap(raw, raw, "toy", ("toy", 0))
+    out = wrapped(jnp.array(np.asarray(x)), y)
+    return float(out[1]), obs.snapshot_counters()
+
+
+@pytest.mark.parametrize("case", ["truncated", "flipped_digest",
+                                  "wrong_jax", "wrong_fingerprint",
+                                  "stale_entry", "garbage_payload"])
+def test_corrupt_artifact_matrix(export_env, case):
+    _, ref_sum, sig, entry = _populate()
+    d = export_bank.bank_dir()
+    path = os.path.join(d, entry["file"])
+
+    if case == "truncated":
+        blob = open(path, "rb").read()
+        open(path, "wb").write(blob[:len(blob) // 2])
+        want, quarantined = "bank.export.rejected.digest", True
+    elif case == "flipped_digest":
+        _mutate_manifest(sig, digest="0" * 64)
+        want, quarantined = "bank.export.rejected.digest", True
+    elif case == "wrong_jax":
+        _mutate_manifest(sig, jax="0.0.1")
+        want, quarantined = "bank.export.rejected.version", True
+    elif case == "wrong_fingerprint":
+        _mutate_manifest(sig, fingerprint="deadbeef0000")
+        want, quarantined = "bank.export.rejected.fingerprint", True
+    elif case == "stale_entry":
+        os.unlink(path)
+        want, quarantined = "bank.export.rejected.missing", False
+    elif case == "garbage_payload":
+        garbage = pickle.dumps({"payload": b"not an executable",
+                                "in_tree": None, "out_tree": None})
+        open(path, "wb").write(garbage)
+        _mutate_manifest(sig, digest=hashlib.sha256(garbage).hexdigest())
+        want, quarantined = "bank.export.corrupt", True
+
+    # Restart 1: the bad artifact is rejected with ITS counter, the
+    # program falls through to a compile, the run completes — and the
+    # miss path re-exports a healthy replacement.
+    got_sum, c = _reload_after_corruption()
+    assert got_sum == ref_sum
+    assert c.get(want, 0) == 1, (case, c)
+    assert c.get("bank.export.hits", 0) == 0
+    assert os.path.exists(path + export_bank.QUARANTINE_SUFFIX) \
+        == quarantined
+    if quarantined:
+        assert c.get("bank.export.quarantined", 0) == 1
+    assert c.get("bank.export.writes", 0) == 1   # healed by re-export
+    # Restart 2: the quarantined artifact CANNOT re-fail — the fresh
+    # replacement serves a clean hit, zero rejections.
+    got_sum2, c2 = _reload_after_corruption()
+    assert got_sum2 == ref_sum
+    assert c2.get(want, 0) == 0, (case, c2)
+    assert c2.get("bank.export.hits", 0) == 1
+    assert c2.get("bank.export.quarantined", 0) == 0
+
+
+# ---------------------------------------------------------------------------
+# fault points (GL006: survivable, :after=N grammar)
+
+
+def test_fault_export_write_is_survivable(export_env, monkeypatch):
+    import jax.numpy as jnp
+    monkeypatch.setenv("EXAML_FAULTS", "bank.export.write")
+    faults.reset()
+    raw, x, y = _toy_program()
+    wrapped = export_bank.wrap(raw, raw, "toy", ("toy", 0))
+    out = wrapped(jnp.array(np.asarray(x)), y)     # must not raise
+    c = obs.snapshot_counters()
+    assert c["bank.export.write_errors"] == 1
+    assert c["faults.fired.bank.export.write"] == 1
+    assert not export_bank.read_exports()          # no artifact
+    del out
+
+
+def test_fault_export_write_after_n_grammar(export_env, monkeypatch):
+    import jax.numpy as jnp
+    monkeypatch.setenv("EXAML_FAULTS", "bank.export.write:after=2")
+    faults.reset()
+    raw, x, y = _toy_program()
+    w1 = export_bank.wrap(raw, raw, "toy", ("toy", 0))
+    w1(jnp.array(np.asarray(x)), y)                # write 1: survives
+    assert len(export_bank.read_exports()) == 1
+    w2 = export_bank.wrap(raw, raw, "toy", ("toy", 1))
+    w2(jnp.array(np.asarray(x)), y)                # write 2: injected
+    c = obs.snapshot_counters()
+    assert c["bank.export.writes"] == 1
+    assert c["bank.export.write_errors"] == 1
+    assert len(export_bank.read_exports()) == 1
+
+
+def test_fault_export_load_is_survivable(export_env, monkeypatch):
+    import jax.numpy as jnp
+    _, ref_sum, sig, entry = _populate()
+    export_bank.reset()
+    obs.reset()
+    monkeypatch.setenv("EXAML_FAULTS", "bank.export.load")
+    faults.reset()
+    raw, x, y = _toy_program()
+    wrapped = export_bank.wrap(raw, raw, "toy", ("toy", 0))
+    out = wrapped(jnp.array(np.asarray(x)), y)     # falls through
+    assert float(out[1]) == ref_sum
+    c = obs.snapshot_counters()
+    assert c["bank.export.rejected.error"] == 1
+    assert c["faults.fired.bank.export.load"] == 1
+    # Environment fault, not a bad artifact: NOT quarantined, and the
+    # next (un-faulted) restart serves it.
+    d = export_bank.bank_dir()
+    assert os.path.exists(os.path.join(d, entry["file"]))
+    monkeypatch.delenv("EXAML_FAULTS", raising=False)
+    faults.reset()
+    export_bank.reset()
+    obs.reset()
+    w2 = export_bank.wrap(raw, _boom, "toy", ("toy", 0))
+    assert float(w2(jnp.array(np.asarray(x)), y)[1]) == ref_sum
+    assert obs.snapshot_counters()["bank.export.hits"] == 1
+
+
+# ---------------------------------------------------------------------------
+# run_bank integration: exported coverage skips compile workers
+
+
+def test_family_coverage_prebackend_scan(tmp_path, monkeypatch):
+    """Coverage must be computable BEFORE the backend initializes (the
+    bank's ordering contract): with no cache dir configured in jax, the
+    root scan finds entries whose backend-independent stamps match."""
+    import jax
+    import jaxlib
+    root = tmp_path / "xroot"
+    part = root / "cpu-fake-partition"
+    part.mkdir(parents=True)
+    fp = config.host_feature_fingerprint() or ""
+    ok = {"family": "fast", "abi": export_bank.EXPORT_ABI,
+          "jax": jax.__version__, "jaxlib": jaxlib.__version__,
+          "fingerprint": fp, "file": "a.jexe", "digest": "x",
+          "ntips": 8}
+    stale = dict(ok, family="grad", jax="0.0.1")
+    other_host = dict(ok, family="universal", fingerprint="feedface0bad")
+    other_data = dict(ok, family="traverse", ntips=50)
+    (part / "bank_manifest.json").write_text(json.dumps(
+        {"exports": {"s1": ok, "s2": stale, "s3": other_host,
+                     "s4": other_data}}))
+    monkeypatch.setenv("EXAML_COMPILE_CACHE", str(root))
+    monkeypatch.setenv("EXAML_EXPORT_BANK", "on")
+    monkeypatch.setattr(config, "persistent_cache_dir", lambda: None)
+    cover = export_bank.family_coverage()
+    assert cover == {"fast": 1, "traverse": 1}   # no ntaxa: no filter
+    # Dataset guard: another dataset's artifacts (ntips mismatch) must
+    # not count as coverage — name-level skip would lose the compile
+    # workers only to miss at warm time.
+    assert export_bank.family_coverage(ntaxa=8) == {"fast": 1}
+    assert export_bank.family_coverage(["grad"]) == {}
+
+
+def test_run_bank_skips_workers_for_covered_families(tmp_path,
+                                                     monkeypatch):
+    """Every enumerated family exported-covered -> run_bank spawns NO
+    compile workers, marks the families 'exported', counts
+    bank.exported_families and joins them to the banked set."""
+    import jax
+    import jaxlib
+    monkeypatch.setenv("EXAML_EXPORT_BANK", "on")
+    fams = bank.enumerate_families("e")
+    fp = config.host_feature_fingerprint() or ""
+    exports = {f"sig{i}": {"family": f, "abi": export_bank.EXPORT_ABI,
+                           "jax": jax.__version__,
+                           "jaxlib": jaxlib.__version__,
+                           "fingerprint": fp, "file": f"{f}.jexe",
+                           "digest": "x"}
+               for i, f in enumerate(fams)}
+    root = tmp_path / "xroot"
+    part = root / "cpu-part"
+    part.mkdir(parents=True)
+    (part / "bank_manifest.json").write_text(
+        json.dumps({"exports": exports}))
+    monkeypatch.setenv("EXAML_COMPILE_CACHE", str(root))
+    monkeypatch.setattr(config, "persistent_cache_dir", lambda: None)
+    obs.reset()
+    args = types.SimpleNamespace(bytefile="unused.binary",
+                                 compile_timeout=5.0, mode="e",
+                                 model="GAMMA", save_memory=False)
+    logs = []
+    report = bank.run_bank(args, log=logs.append)
+    assert set(report) == set(fams)
+    assert all(r["status"] == "exported" for r in report.values())
+    c = obs.snapshot_counters()
+    assert c["bank.exported_families"] == len(fams)
+    assert c.get("bank.no_cache", 0) == 0      # no-worker run: no scare
+    assert all(bank.is_banked(f) for f in fams)
+    assert any("no compile workers spawned" in m for m in logs)
+    bank.reset()
+
+
+# ---------------------------------------------------------------------------
+# CLI cold start: run 2 serves with zero first-call compiles
+# (the fast in-process representative; the SIGKILL supervisor variant
+# is the -m slow e2e below, and CI's coldstart-smoke measures the
+# >=10x wall-clock claim in real subprocesses)
+
+
+def _tiny_cli_fixture(tmp_path, seed=5):
+    from examl_tpu.instance import PhyloInstance
+    from examl_tpu.io.bytefile import write_bytefile
+
+    data = correlated_dna(8, 120, seed=7)
+    bf = str(tmp_path / "tiny.binary")
+    write_bytefile(bf, data)
+    tree = PhyloInstance(data).random_tree(seed)
+    tf = str(tmp_path / "tiny.tree")
+    open(tf, "w").write(tree.to_newick(data.taxon_names))
+    return bf, tf
+
+
+def test_cli_cold_start_zero_compiles(tmp_path, monkeypatch):
+    """Acceptance-shaped: two identical -f e runs against the same
+    workdir/cache; run 1 populates the exported bank, run 2 (cold
+    process state) serves its result with engine.compile_count == 0 and
+    bank.export.hits > 0, at an identical likelihood."""
+    from examl_tpu.cli.main import main
+
+    monkeypatch.setenv("EXAML_COMPILE_TIMEOUT", "180")   # restore after
+    monkeypatch.setenv("EXAML_COMPILE_CACHE", str(tmp_path / "xla"))
+    monkeypatch.setenv("EXAML_EXPORT_BANK", "on")
+    bf, tf = _tiny_cli_fixture(tmp_path)
+    m1, m2 = str(tmp_path / "m1.json"), str(tmp_path / "m2.json")
+    base = ["-s", bf, "-t", tf, "-f", "e", "-w", str(tmp_path / "out"),
+            "--single-device"]
+    try:
+        assert main(base + ["-n", "CS1", "--metrics", m1]) == 0
+        assert main(base + ["-n", "CS2", "--metrics", m2]) == 0
+    finally:
+        monkeypatch.delenv("EXAML_COMPILE_CACHE", raising=False)
+        config.enable_persistent_compilation_cache()     # re-point jax
+    c1 = json.load(open(m1))["counters"]
+    c2 = json.load(open(m2))["counters"]
+    assert c1["engine.compile_count"] > 0                # cold populate
+    assert c1["bank.export.writes"] >= 3
+    assert c1.get("bank.export.write_errors", 0) == 0
+    # THE acceptance line: the restarted run never compiles.
+    assert c2.get("engine.compile_count", 0) == 0, c2
+    assert c2["bank.export.hits"] >= 3
+    assert c2.get("bank.export.rejected.error", 0) == 0
+    assert c2.get("bank.export.corrupt", 0) == 0
+    # Identical result: the exported path runs the same programs.
+    info1 = open(tmp_path / "out" / "ExaML_info.CS1").read()
+    info2 = open(tmp_path / "out" / "ExaML_info.CS2").read()
+    lnl1 = [ln for ln in info1.splitlines() if "Likelihood tree" in ln]
+    lnl2 = [ln for ln in info2.splitlines() if "Likelihood tree" in ln]
+    assert lnl1 and lnl1 == lnl2
+
+
+@pytest.mark.slow          # supervised SIGKILL e2e (~2-3 min): the
+                           # resumed attempt must load from the
+                           # exported bank instead of recompiling
+def test_supervised_sigkill_resumes_from_exported_bank(tmp_path,
+                                                       monkeypatch):
+    from examl_tpu.cli.main import main
+
+    monkeypatch.setenv("EXAML_COMPILE_TIMEOUT", "300")   # restore after
+    monkeypatch.setenv("EXAML_COMPILE_CACHE", str(tmp_path / "xla"))
+    monkeypatch.setenv("EXAML_EXPORT_BANK", "on")
+    bf, tf = _tiny_cli_fixture(tmp_path)
+    m = str(tmp_path / "m.json")
+    try:
+        rc = main(["-s", bf, "-n", "SKX", "-t", tf, "-f", "d", "-i",
+                   "5", "-w", str(tmp_path / "out"), "--bank",
+                   "--supervise", "--supervise-backoff", "0.2",
+                   "--supervise-retries", "3",
+                   "--metrics", m, "--single-device",
+                   "--inject-fault", "search.kill:after=12"])
+    finally:
+        monkeypatch.delenv("EXAML_COMPILE_CACHE", raising=False)
+        config.enable_persistent_compilation_cache()     # re-point jax
+    assert rc == 0
+    snap = json.load(open(m))
+    c = snap["counters"]
+    assert c["resilience.restarts"] >= 1                 # it crashed
+    # The resumed attempt deserialized instead of recompiling: export
+    # hits in its snapshot, and its bank phase skipped covered
+    # families' compile workers.
+    assert c.get("bank.export.hits", 0) > 0, c
+    assert c.get("bank.exported_families", 0) > 0, c
+    # Ledger evidence on the merged timeline (the ledger lives next to
+    # the --metrics file): the resumed attempt's export hits exist (and
+    # quarantine/corruption did not occur).
+    from examl_tpu.obs import ledger as _ledger
+    evs = _ledger.read_dir(str(tmp_path))
+    hits = [e for e in evs if e.get("kind") == "export"
+            and e.get("status") == "hit"]
+    assert hits
+    assert c.get("bank.export.corrupt", 0) == 0
+    assert os.path.exists(tmp_path / "out" / "ExaML_result.SKX")

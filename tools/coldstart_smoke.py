@@ -1,0 +1,216 @@
+#!/usr/bin/env python
+"""Cold-start smoke for the AOT-exported program bank (CI gate,
+.github/workflows/ci.yml `coldstart-smoke`).
+
+Synthesizes a tiny DNA fixture and runs the CLI (a 4-replicate fleet
+bootstrap — the serving-shaped workload) in REAL subprocesses against
+one persistent-cache/workdir:
+
+1. **POP** — `--bank` + `EXAML_EXPORT_BANK=on` against an empty cache:
+   compiles every family and serializes each program into the exported
+   bank (the populate run an autoscaled deployment pays once);
+2. **EXP** — a brand-new process, same cache, still `--bank`: the bank
+   phase must SKIP every covered family's compile worker
+   (`bank.exported_families > 0`) and the run must serve with
+   `engine.compile_count == 0` and `bank.export.hits > 0`;
+3. **EXPLAZY** — a brand-new process, exported bank on, NO `--bank`:
+   the pure load-ladder cold start (what a respawned fleet rank or
+   autoscaled replica pays) — this is the exported-path
+   time-to-first-result;
+4. **COLDBANK** — `EXAML_EXPORT_BANK=off`, `EXAML_COMPILE_CACHE=0`,
+   `--bank`: the cold cacheless provisioning a production replica pays
+   without the exported bank (ROADMAP runs every production search
+   under `--supervise --bank`, so the bank/warm phase IS its cold
+   start);
+5. **COLDLAZY** — cacheless without `--bank`: the weaker lazy baseline,
+   recorded for honesty (it skips provisioning and eats the wedge
+   exposure `--bank` exists to remove).
+
+Time-to-first-result is each run's ledger span from `run start` to the
+inference phase's `end` (backend init and all compiles included,
+interpreter startup excluded).  The smoke asserts COLDBANK/EXPLAZY >=
+`--min-ratio` (default 10x), zero first-call compiles on the exported
+path, and bit-identical per-replicate lnLs, then emits one COLDSTART
+json row for the bench trajectory.
+
+    JAX_PLATFORMS=cpu python tools/coldstart_smoke.py
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+
+def _ttfr(ledger_dir: str) -> float:
+    """Time-to-first-result from a run's ledger: run-start -> first
+    inference-phase end (falls back to run end)."""
+    from examl_tpu.obs import ledger as _ledger
+    evs = _ledger.read_dir(ledger_dir)
+    t0 = next(e["ts"] for e in evs
+              if e.get("kind") == "run" and e.get("status") == "start")
+    t1 = None
+    for e in evs:
+        if e.get("kind") == "phase" and e.get("status") == "end" and \
+                str(e.get("name", "")).startswith("inference"):
+            t1 = e["ts"]
+            break
+    if t1 is None:
+        t1 = max(e["ts"] for e in evs
+                 if e.get("kind") == "run" and e.get("status") == "end")
+    return (t1 - t0) / 1e6
+
+
+def _job_lnls(fleet_table: str) -> list:
+    """[(job_id, lnl)] rows of a fleet results table."""
+    out = []
+    for line in open(fleet_table).read().splitlines():
+        if line.startswith("#") or not line.strip():
+            continue
+        cols = line.split()
+        out.append((cols[0], cols[5]))
+    return sorted(out)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--min-ratio", type=float, default=10.0,
+                    help="required cold-provisioning / exported TTFR "
+                         "ratio (default 10; 0 records without gating)")
+    ap.add_argument("--out", default="COLDSTART.json",
+                    help="bench-row output path (default COLDSTART.json)")
+    args = ap.parse_args()
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import numpy as np
+
+    from examl_tpu.instance import PhyloInstance
+    from examl_tpu.io.alignment import build_alignment_data
+    from examl_tpu.io.bytefile import write_bytefile
+
+    rng = np.random.default_rng(5)
+    names = [f"t{i}" for i in range(8)]
+    seqs = ["".join("ACGT"[b] for b in rng.integers(0, 4, 100))
+            for _ in names]
+    data = build_alignment_data(names, seqs)
+
+    with tempfile.TemporaryDirectory() as d:
+        bf = os.path.join(d, "tiny.binary")
+        write_bytefile(bf, data)
+        tree = PhyloInstance(data).random_tree(5)
+        tf = os.path.join(d, "tiny.tree")
+        with open(tf, "w") as f:
+            f.write(tree.to_newick(names))
+
+        base_env = dict(os.environ)
+        base_env.pop("EXAML_FAULTS", None)
+        base_env.pop("EXAML_HEARTBEAT_FILE", None)
+        pp = [p for p in base_env.get("PYTHONPATH",
+                                      "").split(os.pathsep) if p]
+        base_env["PYTHONPATH"] = os.pathsep.join([REPO] + pp)
+        workdir = os.path.join(d, "out")
+
+        def run(name, extra_env, extra_args=()):
+            led = os.path.join(d, f"ledger.{name}")
+            m = os.path.join(d, f"metrics.{name}.json")
+            env = dict(base_env, **extra_env)
+            argv = [sys.executable, "-m", "examl_tpu.cli.main",
+                    "-s", bf, "-n", name, "-t", tf, "-b", "4",
+                    "-w", workdir, "--metrics", m, "--ledger", led,
+                    "--single-device"] + list(extra_args)
+            out = subprocess.run(argv, env=env, cwd=REPO,
+                                 capture_output=True, text=True,
+                                 timeout=540)
+            if out.returncode != 0:
+                print(out.stdout + out.stderr, file=sys.stderr)
+                raise SystemExit(
+                    f"coldstart smoke: run {name} exited "
+                    f"rc={out.returncode}")
+            c = json.load(open(m)).get("counters", {})
+            return {"counters": c, "ttfr_s": _ttfr(led),
+                    "table": os.path.join(workdir,
+                                          f"ExaML_fleet.{name}")}
+
+        cache = os.path.join(d, "xla")
+        on = {"EXAML_EXPORT_BANK": "on", "EXAML_COMPILE_CACHE": cache}
+        bank_args = ["--bank", "--compile-timeout", "300"]
+        populate = run("POP", on, bank_args)
+        exported = run("EXP", on, bank_args)
+        exp_lazy = run("EXPLAZY", on)
+        cold_bank = run("COLDBANK", {"EXAML_EXPORT_BANK": "off",
+                                     "EXAML_COMPILE_CACHE": "0"},
+                        bank_args)
+        cold_lazy = run("COLDLAZY", {"EXAML_EXPORT_BANK": "off",
+                                     "EXAML_COMPILE_CACHE": "0"})
+        lnls = {n: _job_lnls(r["table"])
+                for n, r in (("EXPLAZY", exp_lazy),
+                             ("EXP", exported),
+                             ("COLDBANK", cold_bank))}
+
+    ratio = cold_bank["ttfr_s"] / max(exp_lazy["ttfr_s"], 1e-9)
+    ec, lc, pc = exported["counters"], exp_lazy["counters"], \
+        populate["counters"]
+    checks = [
+        ("populate had no write errors",
+         pc.get("bank.export.write_errors", 0) == 0),
+        ("exported --bank run: compile workers skipped",
+         ec.get("bank.exported_families", 0) > 0),
+        ("exported --bank run: zero first-call compiles",
+         ec.get("engine.compile_count", 0) == 0),
+        ("exported --bank run: bank.export.hits > 0",
+         ec.get("bank.export.hits", 0) > 0),
+        ("exported lazy run: zero first-call compiles",
+         lc.get("engine.compile_count", 0) == 0),
+        ("exported lazy run: bank.export.hits > 0",
+         lc.get("bank.export.hits", 0) > 0),
+        ("exported runs: no rejections or corruption",
+         not any(k.startswith("bank.export.rejected.")
+                 for c in (ec, lc) for k in c)
+         and ec.get("bank.export.corrupt", 0) == 0
+         and lc.get("bank.export.corrupt", 0) == 0),
+        ("per-replicate lnL parity exported vs cold",
+         lnls["EXPLAZY"] and lnls["EXPLAZY"] == lnls["COLDBANK"]
+         and lnls["EXP"] == lnls["COLDBANK"]),
+    ]
+    if args.min_ratio > 0:
+        checks.append((f"TTFR speedup >= {args.min_ratio:g}x",
+                       ratio >= args.min_ratio))
+
+    row = {"kind": "COLDSTART",
+           "workload": "fleet bootstrap -b 4 (8 taxa x 100bp)",
+           "ttfr_exported_s": round(exp_lazy["ttfr_s"], 3),
+           "ttfr_exported_bank_s": round(exported["ttfr_s"], 3),
+           "ttfr_populate_s": round(populate["ttfr_s"], 3),
+           "ttfr_cold_provision_s": round(cold_bank["ttfr_s"], 3),
+           "ttfr_cold_lazy_s": round(cold_lazy["ttfr_s"], 3),
+           "speedup": round(ratio, 2),
+           "speedup_vs_lazy": round(
+               cold_lazy["ttfr_s"] / max(exp_lazy["ttfr_s"], 1e-9), 2),
+           "export_hits_lazy": int(lc.get("bank.export.hits", 0)),
+           "export_hits_bank": int(ec.get("bank.export.hits", 0)),
+           "exported_families": int(ec.get("bank.exported_families", 0)),
+           "compile_count_exported":
+               int(lc.get("engine.compile_count", 0)),
+           "compile_count_cold":
+               int(cold_lazy["counters"].get("engine.compile_count",
+                                             0))}
+    print("COLDSTART " + json.dumps(row))
+    with open(args.out, "w") as f:
+        json.dump(row, f, indent=2)
+
+    ok = True
+    for name, passed in checks:
+        print(f"coldstart smoke: {name}: {'ok' if passed else 'FAIL'}")
+        ok &= passed
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -92,6 +92,13 @@ ENV_REGISTRY = {
         "doc": "registry",
         "note": "test hook: bank worker hangs on the named family "
                 "(tests/test_bank.py forced-hang e2e)."},
+    "EXAML_EXPORT_BANK": {
+        "doc": "readme",
+        "note": "exported program bank (ops/export_bank.py): on "
+                "serializes/deserializes compiled executables next to "
+                "the persistent cache (zero-compile restart); require "
+                "hard-fails any fall-through (CI gate); default off — "
+                "artifacts are jaxlib+platform locked."},
     # -- observability -----------------------------------------------------
     "EXAML_TRACE_DIR": {
         "doc": "readme",

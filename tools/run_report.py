@@ -37,6 +37,8 @@ from examl_tpu.obs import traffic as _traffic    # noqa: E402
 # bench/perf-lab stopwatches and the bank compile/warm phases).
 _KEY_TIMER_PREFIXES = ("dispatch", "host_schedule", "bench.",
                        "perf_lab.", "bank.compile.", "bank.warm.",
+                       "bank.export_load_seconds",
+                       "bank.export_write_seconds",
                        "engine.compile_seconds.", "engine.grad_pass",
                        "phase.")
 
@@ -249,6 +251,8 @@ def render_bank(out, snap: dict) -> None:
     rows = [(label, int(c[k]))
             for label, k in (("families enumerated", "bank.families"),
                              ("banked (compiled)", "bank.banked"),
+                             ("served from exported bank",
+                              "bank.exported_families"),
                              ("skipped (already cached)", "bank.skipped"),
                              ("compile timeouts", "bank.timeouts"),
                              ("worker errors", "bank.errors"),
@@ -259,7 +263,18 @@ def render_bank(out, snap: dict) -> None:
                               "bank.sharded_residual_families"),
                              ("warm-phase errors", "bank.warm_errors"))
             if c.get(k)]
-    if not rows:
+    exp = [(label, int(c[k]))
+           for label, k in (("hits", "bank.export.hits"),
+                            ("misses", "bank.export.misses"),
+                            ("writes", "bank.export.writes"),
+                            ("write errors", "bank.export.write_errors"),
+                            ("corrupt", "bank.export.corrupt"),
+                            ("quarantined", "bank.export.quarantined"))
+           if c.get(k)]
+    rejected = {k[len("bank.export.rejected."):]: int(v)
+                for k, v in c.items()
+                if k.startswith("bank.export.rejected.") and v}
+    if not rows and not exp and not rejected:
         return
     out("")
     out("Program bank (AOT banking phase):")
@@ -278,6 +293,20 @@ def render_bank(out, snap: dict) -> None:
     if fc:
         out("  first calls                "
             + "  ".join(f"{label}={v}" for label, v in fc))
+    # Exported-artifact ladder evidence: hits with zero compiles is the
+    # zero-compile cold start; rejected.<reason> names exactly which
+    # rung each bad artifact fell through (and quarantined says it
+    # cannot re-fail the next restart).
+    if exp:
+        out("  exported artifacts         "
+            + "  ".join(f"{label}={v}" for label, v in exp))
+    if rejected:
+        out("  export rejections          "
+            + "  ".join(f"{r}={v}" for r, v in sorted(rejected.items())))
+    t = (snap.get("timers") or {}).get("bank.export_load_seconds")
+    if t:
+        out(f"  export load                {t['count']} loads, "
+            f"total {t['total_s']:.3f}s, p95 {t['p95_s'] * 1e3:.1f}ms")
 
 
 def render_counters(out, snap: dict) -> None:

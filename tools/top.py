@@ -118,10 +118,28 @@ def render_frame(out, workdir: str, beats: list, metrics_path,
         except (OSError, ValueError):
             snap = {}
         gauges = snap.get("gauges") or {}
+        all_c = snap.get("counters") or {}
         rows = [(k[len("engine.achieved_gbps."):], v)
                 for k, v in sorted(gauges.items())
                 if k.startswith("engine.achieved_gbps.")]
         tag = " (mid-run flush)" if snap.get("partial") else ""
+        # Exported program bank (ops/export_bank.py): the live
+        # zero-compile-restart evidence — hits with compiles=0 in the
+        # rank rows above IS the cold start the bank exists for;
+        # rejections/quarantines say the load ladder degraded (and to
+        # a counter, not a crash).
+        if all_c.get("bank.export.hits") or all_c.get("bank.export.misses") \
+                or all_c.get("bank.export.writes"):
+            rej = sum(int(v) for k, v in all_c.items()
+                      if k.startswith("bank.export.rejected."))
+            out(f"  export bank{tag}: "
+                f"hits={int(all_c.get('bank.export.hits', 0))}  "
+                f"misses={int(all_c.get('bank.export.misses', 0))}  "
+                f"writes={int(all_c.get('bank.export.writes', 0))}  "
+                f"rejected={rej}  "
+                f"corrupt={int(all_c.get('bank.export.corrupt', 0))}  "
+                f"quarantined="
+                f"{int(all_c.get('bank.export.quarantined', 0))}")
         # Fleet serving view: queue depth, done/total, throughput and
         # the last batch's occupancy — the live row for `-b`/`-N`/
         # `--serve` runs (gauges flush mid-run via the heartbeat tick).
