@@ -818,6 +818,11 @@ def _merge_metrics(results: dict, snapshot: dict) -> None:
     for name, v in (snapshot.get("counters") or {}).items():
         acc["counters"][name] = acc["counters"].get(name, 0) + v
     acc["gauges"].update(snapshot.get("gauges") or {})
+    # Program-observatory rows (obs/programs.py): concatenate across
+    # workers so the BENCH artifact names every program each stage
+    # compiled/loaded, with compiler-truth cost/memory figures.
+    if snapshot.get("programs"):
+        acc.setdefault("programs", []).extend(snapshot["programs"])
     from examl_tpu.obs import hist as _hist
     for name, t in (snapshot.get("timers") or {}).items():
         cur = acc["timers"].get(name)

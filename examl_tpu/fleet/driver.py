@@ -737,6 +737,13 @@ class FleetDriver:
         if dt > 0 and clean \
                 and obs.counter("engine.compile_count") == compiles0:
             obs.gauge("fleet.trees_per_sec", round(njobs / dt, 3))
+        # Per-lane HBM telemetry (obs/programs.py): one rate-limited
+        # device.memory_stats() sample per drain round, covering every
+        # lane's device — the mem.device.<k>.* gauges a multi-tenant
+        # admission decision (ROADMAP §10) needs next to
+        # engine.clv_arena_bytes.
+        from examl_tpu.obs import programs as _programs
+        _programs.sample_memory()
 
     def _isolate_launched(self, batch: List[JobSpec], launched,
                           shard) -> List:

@@ -83,7 +83,16 @@ def add_collector(fn: Callable[[], bool]) -> None:
 
 
 def snapshot() -> dict:
-    return _metrics.registry().snapshot()
+    snap = _metrics.registry().snapshot()
+    # The program observatory's registry rows ride in every snapshot
+    # (and, via bench worker merging, every BENCH artifact) so
+    # tools/run_report.py can render the Programs table from the same
+    # artifact that carries the gauges.
+    from examl_tpu.obs import programs as _programs
+    rows = _programs.table()
+    if rows:
+        snap["programs"] = rows
+    return snap
 
 
 def snapshot_counters() -> dict:

@@ -272,6 +272,16 @@ def maybe_autoflush(force: bool = False) -> bool:
     snap = _REGISTRY.snapshot_light()
     snap["partial"] = True
     snap["flushed_at"] = now
+    try:
+        # Collector-free by design, but the program table is plain
+        # host data — a killed run's last flush should still name the
+        # programs it had compiled (obs/programs.py).
+        from examl_tpu.obs import programs as _programs
+        rows = _programs.table()
+        if rows:
+            snap["programs"] = rows
+    except Exception:                        # noqa: BLE001 — never-raise
+        pass
     tmp = f"{path}.tmp.{os.getpid()}"
     try:
         with open(tmp, "w") as f:

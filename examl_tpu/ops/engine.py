@@ -476,7 +476,7 @@ class LikelihoodEngine:
             nbytes = int(np.prod(self.sev.pool.shape)) * itemsize
         else:
             nbytes = 0
-        obs.gauge("engine.clv_arena_bytes." + self._obs_tag, nbytes)
+        obs.gauge(f"engine.clv_arena_bytes.{self._obs_tag}", nbytes)
 
     # -- traffic accounting (shared roofline model, obs/traffic.py) ---------
 
@@ -550,6 +550,14 @@ class LikelihoodEngine:
         point), but a compile-dominated window would publish a
         near-zero GB/s wrongly tagged bandwidth-meaningful."""
         obs.inc("engine.traffic_bytes", nbytes)
+        # Drift gate (obs/programs.py): reconcile this dispatch's
+        # analytic bytes with the serving program's XLA bytes-accessed
+        # (program.model_drift_pct.<tier>) and learn which source can
+        # back the tier's achieved-GB/s row.  The model stays the
+        # gauge's denominator either way — the tag makes a chip-round
+        # row self-describing, the gate makes model bugs evidence.
+        from examl_tpu.obs import programs as _programs
+        src = _programs.model_vs_xla(tier, nbytes)
         if wall_s is None:
             return
         # The `dispatch` timer the ISSUE/bench share: wall of one
@@ -573,6 +581,14 @@ class LikelihoodEngine:
         obs.gauge(f"engine.achieved_gbps.{label}", round(gbps, 3))
         obs.gauge(f"engine.regime_dispatch_bound.{label}",
                   1.0 if regime["regime"] == "dispatch-bound" else 0.0)
+        # source: model|xla for the row's bytes figure (1.0 = an XLA
+        # bytes-accessed figure exists for the serving program and the
+        # drift gauge above reconciles the two).
+        obs.gauge(f"engine.traffic_source_xla.{label}",
+                  1.0 if src == "xla" else 0.0)
+        # Live HBM telemetry rides the traffic-window cadence: one
+        # rate-limited device.memory_stats() sample per closed window.
+        _programs.sample_memory()
         # Ledger cadence is rate-limited per tier (the gauges above
         # always carry the LATEST verdict): a flight recorder wants
         # periodic bandwidth samples on the timeline, not one line per
@@ -582,7 +598,8 @@ class LikelihoodEngine:
                 _traffic.LEDGER_EVENT_INTERVAL_S:
             self._traffic_led[tier] = now
             obs.ledger_event("traffic.window", tier=tier,
-                             gbps=round(gbps, 3), dispatches=n, **regime)
+                             gbps=round(gbps, 3), dispatches=n,
+                             source=src, **regime)
 
     def _sev_spec_vocab(self) -> dict:
         """PartitionSpec vocabulary + shard_map wrapper for the SEV x
@@ -894,7 +911,7 @@ class LikelihoodEngine:
                 self.tips, self.site_rates)
             self._set_buf(buf)
 
-    def _guard_first_call(self, fn, family: str = "program"):
+    def _guard_first_call(self, fn, family: str = "program", key=None):
         """Wrap a freshly-jitted program so its FIRST invocation (= the
         compile) runs as a timed, event-emitting compile monitor: on the
         axon/TPU remote-compile tunnel a pathological compile blocks in
@@ -940,6 +957,15 @@ class LikelihoodEngine:
             except ValueError:
                 limit = 180.0
             done = threading.Event()
+            # Program observatory (obs/programs.py): count persistent-
+            # cache hits around the compile to attribute its source,
+            # and trace the lowering BEFORE the dispatch donates its
+            # buffers — the registry row's cost/memory analyses come
+            # from AOT-compiling this trace (a cache deserialize when
+            # the persistent cache is armed), never from re-dispatching.
+            from examl_tpu.obs import programs as _programs
+            cache_hits0 = _programs.xla_cache_hits()
+            lowered = _programs.prelower(fn, args, family)
 
             def bark():
                 if not done.wait(limit):
@@ -1023,6 +1049,12 @@ class LikelihoodEngine:
                     else:
                         obs.inc("engine.first_calls.unbanked")
                         obs.inc(f"engine.first_calls.unbanked.{family}")
+                _programs.record(
+                    family, key if key is not None else family,
+                    ("xla-cache"
+                     if _programs.xla_cache_hits() > cache_hits0
+                     else "fresh"),
+                    dt, lowered=lowered)
 
         return call
 
@@ -1062,7 +1094,7 @@ class LikelihoodEngine:
         # bucket pair) must never share an artifact.
         from examl_tpu.ops import export_bank
         family = self._cache_family(key)
-        guarded = self._guard_first_call(fn, family)
+        guarded = self._guard_first_call(fn, family, key=key)
         fn = export_bank.wrap(fn, guarded, family,
                               (key,) + self._export_identity,
                               exportable=self._exportable,

@@ -472,6 +472,13 @@ def load(family: str, sig: str):
         return None
     if loaded is not None:
         mem[sig] = loaded
+        # Program observatory (obs/programs.py): a deserialized
+        # executable answers cost/memory analysis directly, so a
+        # zero-compile cold start (engine.compile_count == 0, the
+        # guard never fires) still gets its registry row — source
+        # "exported", compile seconds 0 by construction.
+        from examl_tpu.obs import programs as _programs
+        _programs.record_loaded(family, sig, loaded)
     return loaded
 
 

@@ -124,6 +124,20 @@ ENV_REGISTRY = {
         "doc": "readme",
         "note": "peak-FLOPs denominator override for bench efficiency "
                 "rows."},
+    "EXAML_PROGRAM_OBS": {
+        "doc": "readme",
+        "note": "program observatory mode: deep (default: registry rows "
+                "+ XLA cost/memory analyses), rows (no analyses), "
+                "off/0 (disabled)."},
+    "EXAML_MEM_SAMPLE_S": {
+        "doc": "readme",
+        "note": "min seconds between device memory_stats() samples "
+                "(default 5; 0 samples every call)."},
+    "EXAML_DRIFT_TOL_PCT": {
+        "doc": "readme",
+        "note": "model-vs-XLA bytes drift tolerance in percent "
+                "(default 25; past it program.model_drift_exceeded "
+                "counts)."},
     # -- resilience / gang process contract --------------------------------
     "EXAML_FAULTS": {
         "doc": "readme",

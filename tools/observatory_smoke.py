@@ -1,0 +1,195 @@
+#!/usr/bin/env python
+"""Program-observatory smoke (CI gate, .github/workflows/ci.yml
+`observatory-smoke`).
+
+Synthesizes a tiny DNA fixture, runs the CLI once in a REAL subprocess
+with the observatory in deep mode and the traffic windows pinned to
+close on every blocking dispatch, then asserts the whole evidence
+chain end to end:
+
+1. the `--metrics` snapshot embeds a populated `"programs"` table and
+   every row carries a source tag (fresh/xla-cache/exported);
+2. on a backend with `cost_analysis` support, rows carry compiler
+   bytes and the drift gate published `program.model_drift_pct.*` —
+   either within `EXAML_DRIFT_TOL_PCT` or with the divergence counted
+   (`program.model_drift_exceeded.*`); where XLA withholds an
+   analysis the degradation is COUNTED (`program.analysis_missing.*`),
+   never silent;
+3. the `programs.p<k>.jsonl` stream next to the ledger parses back to
+   the same families;
+4. both consumers render the new evidence: `tools/run_report.py`
+   prints the Programs table and the memory section, `tools/top.py
+   --once` prints the live memory/programs line;
+5. `run_report --diff` of the snapshot against itself is verdict OK
+   (exit 0) — the regression diff's no-change baseline.
+
+With `--snapshot-out` the run's final metrics snapshot is copied out —
+that is how `tools/reference_snapshot.json` (the warn-only CI diff
+baseline) is regenerated.
+
+    JAX_PLATFORMS=cpu python tools/observatory_smoke.py
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--snapshot-out", default=None,
+                    help="copy the run's final metrics snapshot here "
+                         "(regenerates the committed diff reference)")
+    args = ap.parse_args()
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import numpy as np
+
+    from examl_tpu.instance import PhyloInstance
+    from examl_tpu.io.alignment import build_alignment_data
+    from examl_tpu.io.bytefile import write_bytefile
+    from examl_tpu.obs import programs as _programs
+
+    rng = np.random.default_rng(7)
+    names = [f"t{i}" for i in range(8)]
+    seqs = ["".join("ACGT"[b] for b in rng.integers(0, 4, 100))
+            for _ in names]
+    data = build_alignment_data(names, seqs)
+
+    with tempfile.TemporaryDirectory() as d:
+        bf = os.path.join(d, "tiny.binary")
+        write_bytefile(bf, data)
+        tree = PhyloInstance(data).random_tree(5)
+        tf = os.path.join(d, "tiny.tree")
+        with open(tf, "w") as f:
+            f.write(tree.to_newick(names))
+
+        env = dict(os.environ)
+        env.pop("EXAML_FAULTS", None)
+        env.pop("EXAML_HEARTBEAT_FILE", None)
+        pp = [p for p in env.get("PYTHONPATH", "").split(os.pathsep) if p]
+        env["PYTHONPATH"] = os.pathsep.join([REPO] + pp)
+        # Every blocking dispatch closes a traffic window (so the drift
+        # gate runs), and memory sampling is unthrottled.
+        env.update(EXAML_PROGRAM_OBS="deep",
+                   EXAML_TRAFFIC_WINDOW_DISPATCHES="1",
+                   EXAML_TRAFFIC_WINDOW_WALL_S="0",
+                   EXAML_MEM_SAMPLE_S="0")
+
+        workdir = os.path.join(d, "out")
+        led = os.path.join(d, "led")
+        m = os.path.join(d, "m.json")
+        argv = [sys.executable, "-m", "examl_tpu.cli.main",
+                "-s", bf, "-n", "OBS", "-t", tf, "-b", "4",
+                "-w", workdir, "--metrics", m, "--ledger", led,
+                "--single-device"]
+        out = subprocess.run(argv, env=env, cwd=REPO,
+                             capture_output=True, text=True, timeout=540)
+        if out.returncode != 0:
+            print(out.stdout + out.stderr, file=sys.stderr)
+            raise SystemExit(f"observatory smoke: CLI exited "
+                             f"rc={out.returncode}")
+
+        snap = json.load(open(m))
+        counters = snap.get("counters", {})
+        gauges = snap.get("gauges", {})
+        rows = snap.get("programs") or []
+        stream_rows = _programs.read_dir(led)
+        drift_gauges = {k: v for k, v in gauges.items()
+                        if k.startswith("program.model_drift_pct.")}
+        exceeded = {k: v for k, v in counters.items()
+                    if k.startswith("program.model_drift_exceeded.")}
+        missing = {k: v for k, v in counters.items()
+                   if k.startswith("program.analysis_missing.")}
+        tol = _programs.drift_tolerance_pct()
+        have_xla_bytes = [r for r in rows if r.get("bytes_accessed")]
+
+        rep = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "run_report.py"),
+             "--metrics", m, "--ledger", led],
+            env=env, cwd=REPO, capture_output=True, text=True, timeout=120)
+        top = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "top.py"),
+             "--workdir", d, "--once", "--metrics", m, "--ledger", led],
+            env=env, cwd=REPO, capture_output=True, text=True, timeout=120)
+        diff = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "run_report.py"),
+             "--diff", m, m],
+            env=env, cwd=REPO, capture_output=True, text=True, timeout=120)
+
+        if args.snapshot_out:
+            shutil.copyfile(m, args.snapshot_out)
+            print(f"observatory smoke: snapshot copied to "
+                  f"{args.snapshot_out}")
+
+    checks = [
+        ("snapshot embeds a populated programs table", bool(rows)),
+        ("every program row carries a source tag",
+         rows and all(r.get("source") in ("fresh", "xla-cache",
+                                          "exported") for r in rows)),
+        ("program.records.* counters account for every row",
+         sum(v for k, v in counters.items()
+             if k.startswith("program.records.")) >= len(rows)),
+        ("programs.p<k>.jsonl stream parses back",
+         bool(stream_rows)
+         and {r.get("family") for r in stream_rows}
+         >= {r.get("family") for r in rows}),
+        # Compiler-truth chain: either XLA gave bytes and the drift
+        # gate ran (in-tolerance or counted), or the absence is itself
+        # counted — silence is the only failure.
+        ("XLA bytes present -> drift gate ran",
+         (not have_xla_bytes) or bool(drift_gauges) or bool(exceeded)),
+        ("drift in tolerance or divergence counted",
+         all(abs(v) <= tol for v in drift_gauges.values())
+         or bool(exceeded)),
+        ("no XLA bytes -> degradation counted, not silent",
+         bool(have_xla_bytes) or bool(missing)),
+        ("run_report renders the Programs table",
+         rep.returncode == 0 and "Programs (compiler-truth" in rep.stdout),
+        ("run_report renders the memory section",
+         "Device memory (live allocator" in rep.stdout),
+        ("top --once renders the live memory/programs line",
+         top.returncode == 0 and "memory" in top.stdout
+         and "programs=" in top.stdout),
+        ("self-diff verdict OK",
+         diff.returncode == 0 and "DIFF VERDICT: OK" in diff.stdout),
+    ]
+
+    row = {"kind": "OBSERVATORY",
+           "programs": len(rows),
+           "families": sorted({r.get("family") for r in rows}),
+           "sources": sorted({r.get("source") for r in rows}),
+           "rows_with_xla_bytes": len(have_xla_bytes),
+           "drift_pct": {k.rsplit(".", 1)[1]: round(v, 1)
+                         for k, v in drift_gauges.items()},
+           "drift_exceeded": {k.rsplit(".", 1)[1]: int(v)
+                              for k, v in exceeded.items()},
+           "analyses_missing": {k.split("analysis_missing.", 1)[1]: int(v)
+                                for k, v in missing.items()}}
+    print("OBSERVATORY " + json.dumps(row))
+
+    ok = True
+    for name, passed in checks:
+        print(f"observatory smoke: {name}: {'ok' if passed else 'FAIL'}")
+        ok &= passed
+    if not ok:
+        print("--- run_report stdout tail ---", file=sys.stderr)
+        print("\n".join(rep.stdout.splitlines()[-40:]), file=sys.stderr)
+        print("--- top stdout ---", file=sys.stderr)
+        print(top.stdout, file=sys.stderr)
+        print("--- diff stdout ---", file=sys.stderr)
+        print(diff.stdout + diff.stderr, file=sys.stderr)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

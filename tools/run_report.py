@@ -40,7 +40,7 @@ _KEY_TIMER_PREFIXES = ("dispatch", "host_schedule", "bench.",
                        "bank.export_load_seconds",
                        "bank.export_write_seconds",
                        "engine.compile_seconds.", "engine.grad_pass",
-                       "phase.")
+                       "phase.", "program.analyze_seconds")
 
 
 def _fmt_s(v) -> str:
@@ -72,7 +72,11 @@ def load_ledger(path: str) -> list:
 
 
 def tier_rows_from_metrics(snap: dict) -> list:
-    """[(tier, gbps, regime)] from the engine's windowed gauges."""
+    """[(tier, gbps, regime, source, drift_pct)] from the engine's
+    windowed gauges.  `source` is the bytes-figure provenance tag
+    ("xla" when the program observatory holds a compiler bytes figure
+    for the serving tier, "model" otherwise) and `drift_pct` the
+    model-vs-compiler reconciliation gauge for the tier, when set."""
     gauges = snap.get("gauges") or {}
     rows = []
     for name, gbps in sorted(gauges.items()):
@@ -82,23 +86,30 @@ def tier_rows_from_metrics(snap: dict) -> list:
         db = gauges.get(f"engine.regime_dispatch_bound.{tier}")
         regime = ("dispatch-bound" if db else
                   "bandwidth-meaningful" if db is not None else "?")
-        rows.append((tier, float(gbps), regime))
+        xla = gauges.get(f"engine.traffic_source_xla.{tier}")
+        source = ("xla" if xla else "model" if xla is not None else None)
+        drift = gauges.get(
+            f"program.model_drift_pct.{tier.split('.', 1)[0]}")
+        rows.append((tier, float(gbps), regime, source, drift))
     return rows
 
 
 def tier_rows_from_bench(bench: dict) -> list:
-    """[(label, gbps, regime)] from a BENCH json's per-stage fields."""
+    """[(label, gbps, regime, source, drift)] from a BENCH json's
+    per-stage fields (bench rows carry the analytic model's bytes —
+    source "model" by construction)."""
     rows = []
     if bench.get("achieved_gbps") is not None:
         rows.append((f"small/{bench.get('traversal_variant', '?')}",
                      float(bench["achieved_gbps"]),
-                     bench.get("regime", "?")))
+                     bench.get("regime", "?"), None, None))
     for key, val in sorted(bench.items()):
         if key.endswith("_achieved_gbps") and val is not None:
             pre = key[:-len("_achieved_gbps")]
             rows.append((f"{bench.get(pre + '_config', pre)}"
                          f"/{bench.get(pre + '_variant', '?')}",
-                         float(val), bench.get(pre + "_regime", "?")))
+                         float(val), bench.get(pre + "_regime", "?"),
+                         None, None))
     return rows
 
 
@@ -109,12 +120,131 @@ def render_roofline(out, rows: list, source: str) -> None:
     if not rows:
         out("  (no achieved-GB/s evidence in this artifact)")
         return
-    for tier, gbps, regime in rows:
+    for tier, gbps, regime, src, drift in rows:
         pct = 100.0 * gbps / target
         flag = ("" if regime == "bandwidth-meaningful"
                 else "  [NOT a bandwidth number]")
+        tag = ""
+        if src is not None:
+            tag = f"  source={src}"
+            if drift is not None:
+                tag += f" drift={drift:.1f}%"
         out(f"  {tier:24s} {gbps:10.2f} GB/s  ({pct:6.2f}% of target)"
-            f"  {regime}{flag}")
+            f"  {regime}{flag}{tag}")
+
+
+# -- program observatory -----------------------------------------------------
+
+
+def _fmt_bytes(v) -> str:
+    if v is None:
+        return "-"
+    v = float(v)
+    if v >= 1e9:
+        return f"{v / 1e9:.2f}G"
+    if v >= 1e6:
+        return f"{v / 1e6:.1f}M"
+    if v >= 1e3:
+        return f"{v / 1e3:.1f}K"
+    return f"{v:.0f}"
+
+
+def program_rows(snap: dict, bench: dict = None) -> list:
+    """The observatory table embedded in a metrics snapshot (or, for
+    BENCH artifacts, in the workers' merged registry)."""
+    rows = snap.get("programs") or []
+    if not rows and bench:
+        rows = (bench.get("programs")
+                or (bench.get("metrics") or {}).get("programs") or [])
+    return rows
+
+
+def render_programs(out, snap: dict, bench: dict = None) -> None:
+    """The Programs table (obs/programs.py): one row per compiled or
+    deserialized executable with its compile source and the compiler's
+    own cost/memory accounting — the memory column is XLA's structural
+    peak (argument+output+temp), the figure the analytic model cannot
+    provide."""
+    rows = program_rows(snap, bench)
+    if not rows:
+        return
+    out("")
+    out("Programs (compiler-truth observatory, obs/programs.py):")
+    out(f"  {'family':12s} {'source':9s} {'compile':>8s} {'flops':>8s} "
+        f"{'bytes_acc':>9s} {'arg':>7s} {'out':>7s} {'tmp':>7s} "
+        f"{'peak':>7s}  key")
+    for r in rows:
+        out(f"  {str(r.get('family', '?')):12s} "
+            f"{str(r.get('source', '?')):9s} "
+            f"{_fmt_s(r.get('compile_s')):>8s} "
+            f"{_fmt_bytes(r.get('flops')):>8s} "
+            f"{_fmt_bytes(r.get('bytes_accessed')):>9s} "
+            f"{_fmt_bytes(r.get('argument_bytes')):>7s} "
+            f"{_fmt_bytes(r.get('output_bytes')):>7s} "
+            f"{_fmt_bytes(r.get('temp_bytes')):>7s} "
+            f"{_fmt_bytes(r.get('peak_bytes')):>7s}  "
+            f"{str(r.get('key', ''))[:28]}")
+    c = snap.get("counters") or {}
+    srcs = {k[len("program.records."):]: int(v) for k, v in c.items()
+            if k.startswith("program.records.")}
+    if srcs:
+        out("  sources                    "
+            + "  ".join(f"{s}={v}" for s, v in sorted(srcs.items())))
+    missing = {k[len("program.analysis_missing."):]: int(v)
+               for k, v in c.items()
+               if k.startswith("program.analysis_missing.")}
+    if missing:
+        out("  analyses degraded          "
+            + "  ".join(f"{f}={v}" for f, v in sorted(missing.items()))
+            + "  (fallback-not-crash ladder: the analytic model "
+              "carried these)")
+    exceeded = {k[len("program.model_drift_exceeded."):]: int(v)
+                for k, v in c.items()
+                if k.startswith("program.model_drift_exceeded.")}
+    if exceeded:
+        out("  drift gate                 "
+            + "  ".join(f"{t}={v}" for t, v in sorted(exceeded.items()))
+            + "  dispatches past tolerance (documented divergence — "
+              "see ROOFLINE.md 'Compiler-truth bytes')")
+
+
+def render_memory(out, snap: dict) -> None:
+    """Live HBM telemetry: mem.device.<k>.* allocator gauges
+    cross-checked against the modeled CLV arena
+    (engine.clv_arena_bytes.*).  A backend with no allocator stats
+    (CPU) shows the degradation counter instead of fake numbers."""
+    g = snap.get("gauges") or {}
+    devs = {}
+    for k, v in g.items():
+        if not k.startswith("mem.device."):
+            continue
+        rest = k[len("mem.device."):]
+        if "." not in rest:
+            continue
+        dev, field = rest.split(".", 1)
+        devs.setdefault(dev, {})[field] = v
+    arena = sum(v for k, v in g.items()
+                if k.startswith("engine.clv_arena_bytes."))
+    c = snap.get("counters") or {}
+    missing = int(c.get("program.analysis_missing.memory_stats", 0))
+    if not devs and not arena:
+        return
+    out("")
+    out("Device memory (live allocator stats vs modeled arena):")
+    for dev in sorted(devs):
+        d = devs[dev]
+        line = (f"  device {dev:4s} "
+                f"in_use={_fmt_bytes(d.get('in_use'))} "
+                f"peak={_fmt_bytes(d.get('peak'))} "
+                f"limit={_fmt_bytes(d.get('limit'))}")
+        if arena and d.get("in_use"):
+            line += (f"  (CLV arena {_fmt_bytes(arena)} = "
+                     f"{100.0 * arena / d['in_use']:.0f}% of in_use)")
+        out(line)
+    if not devs:
+        out(f"  CLV arena (modeled)        {_fmt_bytes(arena)}"
+            + (f"  (no allocator stats on this backend; "
+               f"memory_stats degraded x{missing})" if missing else ""))
 
 
 # -- timers / histogram quantiles -------------------------------------------
@@ -448,6 +578,8 @@ def render(metrics: dict, events: list, bench: dict,
     if not rows and not bench:
         render_roofline(out, [], "no artifact")
     render_timers(out, metrics)
+    render_programs(out, metrics, bench)
+    render_memory(out, metrics)
     render_bank(out, metrics)
     render_fleet(out, metrics, events)
     render_counters(out, metrics)
@@ -458,6 +590,142 @@ def render(metrics: dict, events: list, bench: dict,
         render_timers(out, bench["metrics"])
         render_counters(out, bench["metrics"])
     render_timeline(out, events, timeline)
+
+
+# -- snapshot diff (the perf-regression sentinel) ----------------------------
+
+# Counters whose mere GROWTH between two comparable runs is a finding
+# (error/degradation evidence, not workload scale).
+_DIFF_ALARM_COUNTERS = (
+    "engine.watchdog_barks", "engine.pallas_fallbacks",
+    "bank.export.write_errors", "bank.export.corrupt",
+    "bank.export.quarantined", "fleet.quarantined", "fleet.rejected",
+    "engine.first_calls.unbanked",
+)
+# Context counters rendered for scale calibration (a diff of runs with
+# wildly different dispatch counts is a workload change, not a perf
+# regression).
+_DIFF_SCALE_COUNTERS = (
+    "engine.dispatch_count", "engine.compile_count",
+    "engine.compile_seconds", "engine.traffic_bytes",
+)
+
+
+def _pct(old: float, new: float):
+    if not old:
+        return None
+    return 100.0 * (new - old) / old
+
+
+def _fmt_pct(p) -> str:
+    return "   -  " if p is None else f"{p:+6.1f}%"
+
+
+def diff_snapshots(old: dict, new: dict, out=print,
+                   gbps_tol_pct: float = 10.0,
+                   latency_tol_pct: float = 25.0) -> list:
+    """Compare two `--metrics` snapshots — counters, timer quantiles,
+    per-tier achieved GB/s, program table — and return the list of
+    regression findings (empty = OK).  The verdict line is the last
+    line printed, so a CI log tail always carries it."""
+    findings = []
+    oc = old.get("counters") or {}
+    nc = new.get("counters") or {}
+
+    out("Snapshot diff (OLD -> NEW):")
+    out("  scale:")
+    for k in _DIFF_SCALE_COUNTERS:
+        if oc.get(k) or nc.get(k):
+            out(f"    {k:36s} {oc.get(k, 0):>12,.0f} -> "
+                f"{nc.get(k, 0):>12,.0f}  "
+                f"{_fmt_pct(_pct(oc.get(k, 0), nc.get(k, 0)))}")
+    for k in _DIFF_ALARM_COUNTERS:
+        delta = nc.get(k, 0) - oc.get(k, 0)
+        if delta > 0:
+            findings.append(f"{k} grew by {delta:.0f}")
+            out(f"    {k:36s} {oc.get(k, 0):>12,.0f} -> "
+                f"{nc.get(k, 0):>12,.0f}  REGRESSION")
+
+    # Per-tier achieved GB/s: a drop past tolerance on a tier both
+    # snapshots measured is the roofline regression this sentinel
+    # exists for (dispatch-bound rows compare but cannot regress —
+    # their number is a launch-floor artifact by definition).
+    o_rows = {t: (g, r) for t, g, r, _, _ in tier_rows_from_metrics(old)}
+    n_rows = {t: (g, r) for t, g, r, _, _ in tier_rows_from_metrics(new)}
+    tiers = sorted(set(o_rows) | set(n_rows))
+    if tiers:
+        out("  per-tier achieved GB/s:")
+    for t in tiers:
+        og, orr = o_rows.get(t, (None, None))
+        ng, nrr = n_rows.get(t, (None, None))
+        if og is None or ng is None:
+            out(f"    {t:28s} "
+                f"{'-' if og is None else f'{og:.2f}':>10s} -> "
+                f"{'-' if ng is None else f'{ng:.2f}':>10s}  "
+                "(tier present in one snapshot only)")
+            continue
+        p = _pct(og, ng)
+        flag = ""
+        if (p is not None and p < -gbps_tol_pct
+                and orr == "bandwidth-meaningful"
+                and nrr == "bandwidth-meaningful"):
+            flag = "  REGRESSION"
+            findings.append(f"tier {t} gbps {og:.2f} -> {ng:.2f} "
+                            f"({p:+.1f}%)")
+        out(f"    {t:28s} {og:>10.2f} -> {ng:>10.2f}  {_fmt_pct(p)}"
+            f"  [{nrr}]{flag}")
+
+    # Timer quantiles: p95 growth past tolerance on the key timers.
+    ot = old.get("timers") or {}
+    nt = new.get("timers") or {}
+    keys = [k for k in sorted(set(ot) & set(nt))
+            if any(k == p or k.startswith(p)
+                   for p in _KEY_TIMER_PREFIXES)]
+    if keys:
+        out("  timer p95:")
+    for k in keys:
+        op, np_ = ot[k].get("p95_s"), nt[k].get("p95_s")
+        if op is None or np_ is None:
+            continue
+        p = _pct(op, np_)
+        flag = ""
+        if p is not None and p > latency_tol_pct and np_ > 1e-4:
+            flag = "  REGRESSION"
+            findings.append(f"timer {k} p95 {_fmt_s(op)} -> "
+                            f"{_fmt_s(np_)} ({p:+.1f}%)")
+        out(f"    {k:36s} {_fmt_s(op):>10s} -> {_fmt_s(np_):>10s}  "
+            f"{_fmt_pct(p)}{flag}")
+
+    # Program table: per-family compiler-truth bytes must be stable
+    # between comparable runs — a moved bytes_accessed is a program
+    # (or model) change arriving with its cause attached.
+    op_rows = {r.get("family"): r for r in program_rows(old)}
+    np_rows = {r.get("family"): r for r in program_rows(new)}
+    fams = sorted(set(op_rows) | set(np_rows))
+    if fams:
+        out("  programs (bytes_accessed per family):")
+    for fam in fams:
+        ob = (op_rows.get(fam) or {}).get("bytes_accessed")
+        nb = (np_rows.get(fam) or {}).get("bytes_accessed")
+        p = _pct(ob or 0, nb or 0) if ob and nb else None
+        note = ("new family" if fam not in op_rows else
+                "family gone" if fam not in np_rows else "")
+        flag = ""
+        if p is not None and abs(p) > gbps_tol_pct:
+            flag = "  REGRESSION"
+            findings.append(f"program {fam} bytes_accessed "
+                            f"{_fmt_bytes(ob)} -> {_fmt_bytes(nb)} "
+                            f"({p:+.1f}%)")
+        out(f"    {str(fam):28s} {_fmt_bytes(ob):>10s} -> "
+            f"{_fmt_bytes(nb):>10s}  {_fmt_pct(p)}  {note}{flag}")
+
+    if findings:
+        out(f"DIFF VERDICT: REGRESSION ({len(findings)} finding(s))")
+        for f in findings:
+            out(f"  - {f}")
+    else:
+        out("DIFF VERDICT: OK (no regressions past tolerance)")
+    return findings
 
 
 def main(argv=None) -> int:
@@ -471,7 +739,25 @@ def main(argv=None) -> int:
                          "line saved to a file)")
     ap.add_argument("--timeline", type=int, default=60,
                     help="max timeline events to print (default 60)")
+    ap.add_argument("--diff", nargs=2, metavar=("OLD", "NEW"),
+                    default=None,
+                    help="compare two --metrics snapshots (counters, "
+                         "timer quantiles, per-tier GB/s, program "
+                         "table) and print a regression verdict; exit "
+                         "4 on regression")
+    ap.add_argument("--diff-gbps-tol", type=float, default=10.0,
+                    help="achieved-GB/s drop tolerated before a diff "
+                         "regression verdict (percent, default 10)")
+    ap.add_argument("--diff-latency-tol", type=float, default=25.0,
+                    help="timer-p95 growth tolerated before a diff "
+                         "regression verdict (percent, default 25)")
     args = ap.parse_args(argv)
+    if args.diff:
+        findings = diff_snapshots(
+            load_metrics(args.diff[0]), load_metrics(args.diff[1]),
+            gbps_tol_pct=args.diff_gbps_tol,
+            latency_tol_pct=args.diff_latency_tol)
+        return 4 if findings else 0
     if not (args.metrics or args.ledger or args.bench):
         ap.error("at least one of --metrics/--ledger/--bench is required")
     metrics = load_metrics(args.metrics) if args.metrics else {}

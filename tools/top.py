@@ -172,9 +172,54 @@ def render_frame(out, workdir: str, beats: list, metrics_path,
                 f"occupancy={gauges.get('fleet.batch_occupancy', 0.0):.2f}"
                 + fd)
         if rows:
+            srcs = {k[len("engine.traffic_source_xla."):]: v
+                    for k, v in gauges.items()
+                    if k.startswith("engine.traffic_source_xla.")}
             out(f"  roofline{tag}: "
-                + "  ".join(f"{t}={v:.3g}GB/s" for t, v in rows))
-        elif snap:
+                + "  ".join(
+                    f"{t}={v:.3g}GB/s"
+                    + ("[xla]" if srcs.get(t) else
+                       "[model]" if t in srcs else "")
+                    for t, v in rows))
+        # Live memory line (obs/programs.py HBM telemetry): per-device
+        # allocator gauges next to the modeled CLV arena, plus the
+        # program-observatory row count and the model-vs-compiler
+        # drift verdict — the operator's view of whether the bytes
+        # figures are compiler-backed.
+        mem = {}
+        for k, v in gauges.items():
+            if not k.startswith("mem.device."):
+                continue
+            rest = k[len("mem.device."):]
+            if "." not in rest:
+                continue
+            dev, field = rest.split(".", 1)
+            mem.setdefault(dev, {})[field] = v
+        arena = sum(v for k, v in gauges.items()
+                    if k.startswith("engine.clv_arena_bytes."))
+        drifts = {k[len("program.model_drift_pct."):]: v
+                  for k, v in gauges.items()
+                  if k.startswith("program.model_drift_pct.")}
+        nprog = int(gauges.get("program.count", 0)) \
+            or len(snap.get("programs") or [])
+        if mem or arena or nprog:
+            def _mb(v):
+                if not v:
+                    return "-"
+                return (f"{v / 1e6:.0f}M" if v >= 10e6
+                        else f"{v / 1e6:.1f}M")
+            parts = [f"d{d} {_mb(m.get('in_use'))}/"
+                     f"{_mb(m.get('limit'))} peak={_mb(m.get('peak'))}"
+                     for d, m in sorted(mem.items())]
+            if not parts and arena:
+                parts = ["(no allocator stats on this backend)"]
+            out(f"  memory{tag}: " + "  ".join(parts)
+                + (f"  arena={_mb(arena)}" if arena else "")
+                + (f"  programs={nprog}" if nprog else "")
+                + ("  drift=" + ",".join(
+                    f"{t}:{v:.0f}%" for t, v in sorted(drifts.items()))
+                   if drifts else ""))
+        if not rows and snap:
             out(f"  metrics{tag}: "
                 f"{len(snap.get('counters') or {})} counters, "
                 f"{len(snap.get('timers') or {})} timers "
