@@ -1077,19 +1077,57 @@ def main(argv=None) -> int:
                                         args.serve))
     if fleet_modes > 1:
         ap.error("-b, -N and --serve are mutually exclusive fleet modes")
+    # Declared (sites, tree) likelihood fabric (ISSUE 17): parse the
+    # mesh spec at argument time so every shape error is an ap.error,
+    # and pin down exactly which (S, T) combinations cannot compose.
+    from examl_tpu.parallel.launch import mesh_spec_requested
+    mesh_shape = None
+    _mesh_spec = mesh_spec_requested(args)
+    if _mesh_spec is not None:
+        from examl_tpu.parallel.sharding import parse_mesh_spec
+        try:
+            mesh_shape = parse_mesh_spec(_mesh_spec)
+        except ValueError as exc:
+            ap.error(f"--mesh: {exc}")
+        if args.single_device and mesh_shape != (1, 1):
+            ap.error("--mesh SxT declares a device mesh; it cannot "
+                     "combine with --single-device (use --mesh 1x1 "
+                     "for an explicit single-device run)")
+        if mesh_shape[1] > 1 and not fleet_modes:
+            ap.error(f"mesh {mesh_shape[0]}x{mesh_shape[1]}: the tree "
+                     "axis batches independent fleet jobs, so T>1 "
+                     "needs a fleet mode (-b/-N/--serve); a single "
+                     f"-f search uses --mesh {mesh_shape[0]}x1")
+        if args.save_memory and mesh_shape[1] > 1:
+            ap.error(f"mesh {mesh_shape[0]}x{mesh_shape[1]} cannot "
+                     "compose with -S: the SEV pool holds ONE arena "
+                     "per instance, so per-job arenas cannot stack "
+                     "along the tree axis — only Sx1 meshes support "
+                     f"-S (use --mesh {mesh_shape[0]}x1 without a "
+                     "fleet mode)")
     if fleet_modes:
         if args.mode == "q":
             ap.error("fleet modes (-b/-N/--serve) replace the -f "
                      "algorithm; they cannot combine with -f q")
         if args.save_memory:
-            # The one genuinely unsupported combination (ISSUE 14): the
-            # SEV pool holds ONE arena per instance, so per-job arenas
-            # cannot stack along a tree axis and per-device lanes
-            # cannot each own a pool region.
-            ap.error("fleet modes do not support -S (the SEV pool holds "
-                     "one arena per instance; batched/sharded per-job "
-                     "arenas cannot stack — ISSUE 14 keeps this the "
-                     "only unrouted combination)")
+            # The one genuinely unsupported composition: the SEV pool
+            # holds ONE arena per instance, so per-job arenas cannot
+            # stack along the tree axis for ANY (S, T) — the precise
+            # shape is named so the operator knows the mesh router
+            # looked and declined, not that routing is missing.
+            s_sh = mesh_shape[0] if mesh_shape else 1
+            t_sh = mesh_shape[1] if mesh_shape else "J"
+            ap.error(f"fleet modes do not support -S: the (S={s_sh}, "
+                     f"T={t_sh}) combination cannot compose because "
+                     "the SEV pool holds one arena per instance and "
+                     "per-job arenas cannot stack along the tree "
+                     "axis; drop -S, or run Sx1 site sharding "
+                     "without a fleet mode")
+        if mesh_shape is not None and args.fleet_devices != 1:
+            ap.error("--mesh and --fleet-devices are mutually "
+                     "exclusive: the fabric's tree axis replaces the "
+                     "per-device lane round-robin (T slices of one "
+                     "mesh instead of whole-device lanes)")
         if args.bootstrap and not args.tree_file:
             ap.error("-b bootstrap replicates resample weights on a "
                      "fixed topology: a starting tree (-t) is required")
@@ -1126,11 +1164,15 @@ def main(argv=None) -> int:
             # so repeated in-process main() calls never leak a rank.
             args._fleet_rank = (args.procid or 0, args.nprocs or 1)
             args.nprocs = args.coordinator = args.procid = None
-        # The batched tier owns the whole LOCAL device set: per-job
-        # arenas stack along a leading tree axis and round-robin across
-        # device lanes instead of sharding one tree's site axis
-        # (exactly BEAGLE's multi-analysis device-sharing trade).
-        if not getattr(args, "single_device", False):
+        # Without a declared mesh the batched tier owns the whole LOCAL
+        # device set: per-job arenas stack along a leading tree axis
+        # and round-robin across device lanes instead of sharding one
+        # tree's site axis (exactly BEAGLE's multi-analysis
+        # device-sharing trade).  A `--mesh SxT` run composes BOTH
+        # instead (ISSUE 17): site shards and tree slices on one
+        # fabric, so the blanket single-device pin must not fire.
+        if mesh_shape is None and not getattr(args, "single_device",
+                                              False):
             args.single_device = True
 
     from examl_tpu.resilience import faults as _faults
@@ -1382,7 +1424,15 @@ def _run(args, files: RunFiles) -> int:
             # cache -> fresh-compile per program; a restarted or cold
             # process reaches its first dispatch without compiling.
             files.info(export_bank.startup_info())
-        sharding = select_sharding(args, args.save_memory, log=files.info)
+        try:
+            sharding = select_sharding(args, args.save_memory,
+                                       log=files.info)
+        except ValueError as exc:
+            # A declared mesh that does not fit the visible devices
+            # (e.g. --mesh 4x2 on 4 chips): a configuration error with
+            # the exact (S, T)-vs-devices arithmetic, not a traceback.
+            files.info(f"ERROR: {exc}")
+            return 1
         # Multi-process jobs read only their own site columns (the
         # reference's readMyData) — policy in selective_read_decision.
         local_window = None

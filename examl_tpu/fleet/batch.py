@@ -92,11 +92,17 @@ class PendingBatch:
 def batch_eligible(inst) -> Optional[str]:
     """None when the instance can take the batched tier, else the
     human-readable reason it cannot (the driver degrades to sequential
-    evaluation and says why)."""
+    evaluation and says why).
+
+    Sharded engines ARE eligible single-process (ISSUE 17): the job
+    stacks commit over the fabric's tree axis (or replicate over a 1-D
+    site mesh) and GSPMD composes them with the site-sharded engine
+    constants in one dispatch.  Multi-process sharding stays out — a
+    per-job stack cannot span process-local shards."""
     if getattr(inst, "save_memory", False):
         return "-S SEV pools hold one arena per instance"
     for eng in inst.engines.values():
-        if eng.sharding is not None:
+        if eng.sharding is not None and jax.process_count() > 1:
             return "multi-process sharded arenas cannot stack per job"
     return None
 

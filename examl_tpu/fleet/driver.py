@@ -90,6 +90,21 @@ class FleetDriver:
         # any rank finishes everywhere.
         self.leases = leases
         self.peer_journals = peer_journals
+        # Fabric dispatch (ISSUE 17): a fabric-sharded instance hands
+        # the driver a MeshShard evaluator — every batch spans the
+        # whole (sites, tree) mesh in ONE dispatch, so the driver's
+        # lane logic above stays single-lane and untouched.  Lease
+        # records carry the shape so the evidence trail names the
+        # fabric that held each job.
+        from examl_tpu.fleet.shard import MeshShard
+        if isinstance(self.evaluator, MeshShard):
+            shape = (f"{self.evaluator.site_shards}x"
+                     f"{self.evaluator.tree_shards}")
+            self.log(f"fleet: batches dispatch on the {shape} "
+                     "likelihood fabric (tree axis partitions each "
+                     "batch's jobs; site axis shards each job's blocks)")
+            if self.leases is not None:
+                self.leases.mesh = shape
         self._reap_after: Dict[str, float] = {}
         self._reap_tries: Dict[str, int] = {}
         self._last_absorb = 0.0

@@ -84,6 +84,11 @@ class LeaseBoard:
         self.attempt = int(attempt)     # supervisor restart count: a
         # restarted rank's fresh leases are distinguishable from its
         # dead incarnation's in the evidence trail.
+        # Informational fabric tag ("SxT", set by the driver when the
+        # rank dispatches on a likelihood fabric): lease records then
+        # say WHICH mesh shape held a job, so a post-mortem on a mixed
+        # fleet can tell a fabric rank's leases from a classic lane's.
+        self.mesh: Optional[str] = None
         self._nonce = 0
         # job_id -> {nonce, deadline} we last published.  Guarded by
         # `_mu`: the KEEPALIVE thread (below) renews concurrently with
@@ -116,9 +121,12 @@ class LeaseBoard:
             self._nonce += 1
             n = self._nonce
         nonce = f"r{self.rank}.{self.attempt}.{os.getpid()}.{n}"
-        return {"job_id": job_id, "rank": self.rank,
-                "attempt": self.attempt,
-                "deadline": time.time() + self.ttl_s, "nonce": nonce}
+        rec = {"job_id": job_id, "rank": self.rank,
+               "attempt": self.attempt,
+               "deadline": time.time() + self.ttl_s, "nonce": nonce}
+        if self.mesh:
+            rec["mesh"] = self.mesh
+        return rec
 
     def _stage_fsync(self, job_id: str, rec: dict) -> str:
         """Write + fsync the record to a rank-private tmp: after this

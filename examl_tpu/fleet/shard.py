@@ -32,9 +32,12 @@ from typing import List, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
 
 from examl_tpu import obs
 from examl_tpu.fleet.batch import WEIGHTS_GROUP, BatchEvaluator
+from examl_tpu.utils import next_pow2
 
 # Engine constants the batched dispatch bodies take as arguments — the
 # full set a lane must hold device-resident copies of.
@@ -74,10 +77,112 @@ class DeviceShard(BatchEvaluator):
                 jax.device_put(scaler, self.device))
 
 
+class MeshShard(BatchEvaluator):
+    """The DeviceShard generalization for the declared (sites, tree)
+    fabric (ISSUE 17): instead of one whole-device lane per batch, ONE
+    dispatch spans every mesh slice — the stacked per-job leaves commit
+    with `P("tree")` on the leading job axis and the fresh batch arenas
+    with `P("tree", None, "sites")` on (jobs, blocks), so GSPMD
+    partitions jobs across the T tree slices while each job's packed
+    block axis shards over that slice's S devices.  The engine
+    constants need no copies at all: they are the instance's LIVE
+    arrays, already committed to the same fabric with site-only specs
+    (replicated per tree slice) — which is also why the weights-batch /
+    smoothing / universal work that anchors to the live arenas runs
+    through this same evaluator instead of needing a separate primary
+    lane.
+
+    The only cross-slice traffic in the compiled program is the root
+    lnL segment-sum's all-reduce over `sites` (ExaML's one Allreduce);
+    the per-job outputs stay sharded over `tree` with no tree-axis
+    collective (tests/test_mesh.py pins both by HLO census).
+
+    Job pads round up to a multiple of T on top of the usual power of
+    two so the tree axis always divides the stack evenly; occupancy
+    below 1 from that rounding is recorded by the same
+    `fleet.batch_occupancy` gauge as classic padding."""
+
+    def __init__(self, inst):
+        super().__init__(inst)
+        sh = self.engines[0].sharding
+        assert sh is not None and sh.is_fabric, \
+            "MeshShard needs a fabric-sharded instance"
+        self.mesh = sh.mesh
+        self.site_shards = sh.site_shards
+        self.tree_shards = sh.tree_shards
+        self.index = 0            # lane id for the driver's counters
+        self._jobs_sh = NamedSharding(self.mesh, P("tree"))
+        self._arena_sh = NamedSharding(self.mesh, P("tree", None, "sites"))
+        # Probe the fabric with a real tiny sharded dispatch: a mesh
+        # whose devices cannot even sum a committed vector must fail
+        # at INIT with the mesh shape in hand, not poison a job batch.
+        probe = jax.device_put(
+            jnp.zeros((self.tree_shards * max(1, self.site_shards),),
+                      jnp.float32), self._jobs_sh)
+        float(jnp.sum(probe + 1.0))
+        obs.gauge("fleet.mesh_tree_shards", self.tree_shards)
+
+    def _pick_jpad(self, group_key, J: int) -> int:
+        """Smallest already-compiled pad that fits, else the next power
+        of two rounded up to a tree-axis multiple (for pow2 T this IS
+        the next power of two >= max(J, T)).  Every batch's pad passes
+        through here exactly once per launch, so per-slice dispatch
+        accounting rides along: job rows land on tree slice
+        k = row // (jpad/T) in stacking order, making slice occupancy a
+        pure function of (J, jpad) — no device traffic."""
+        compiled = self._jpads.setdefault(group_key, set())
+        fits = [p for p in compiled if p >= J]
+        if fits:
+            jpad = min(fits)
+        else:
+            T = self.tree_shards
+            jpad = T * next_pow2((J + T - 1) // T)
+            compiled.add(jpad)
+        per = max(1, jpad // self.tree_shards)
+        obs.inc("fleet.mesh_batches")
+        for k in range(self.tree_shards):
+            real = min(max(J - k * per, 0), per)
+            obs.inc(f"fleet.mesh_slice_dispatches.t{k}")
+            if real:
+                obs.inc(f"fleet.mesh_slice_jobs.t{k}", real)
+        return jpad
+
+    def _pad_stack(self, arrs, jpad: int):
+        arrs = list(arrs) + [arrs[0]] * (jpad - len(arrs))
+        return jax.device_put(jnp.stack([jnp.asarray(a) for a in arrs]),
+                              self._jobs_sh)
+
+    def _batch_arenas(self, eng, jpad: int):
+        rows = eng.n_inner + eng.fast_slack + 1
+        return (self._zeros(
+                    (jpad, rows, eng.B, eng.lane, eng.R, eng.K),
+                    eng.storage_dtype),
+                self._zeros((jpad, rows, eng.B, eng.lane), jnp.int32))
+
+    def _zeros(self, shape, dtype):
+        """Batch arenas born sharded over (tree, ·, sites) — the
+        engine's `_zeros_sharded` discipline: the stacked CLV arena is
+        the fleet's dominant allocation and must never stage whole on
+        one device."""
+        npdtype = np.dtype(dtype)
+
+        def shard_zeros(idx):
+            shard_shape = tuple(
+                len(range(*sl.indices(dim)))
+                for sl, dim in zip(idx, shape))
+            return np.zeros(shard_shape, dtype=npdtype)
+
+        return jax.make_array_from_callback(shape, self._arena_sh,
+                                            shard_zeros)
+
 class ShardSet:
     """The drivable set of evaluation lanes: the primary evaluator
     (default device — also the weights-batch / smoothing / universal
-    lane) plus one DeviceShard per surviving additional local device."""
+    lane) plus one DeviceShard per surviving additional local device.
+
+    A MeshShard primary (fabric-sharded instance) is already every
+    device's lane — the set stays single-lane and never cuts
+    whole-device DeviceShards on top of the fabric."""
 
     def __init__(self, inst, primary: Optional[BatchEvaluator],
                  max_devices: int = 0, log=None):
@@ -90,6 +195,15 @@ class ShardSet:
             obs.gauge("fleet.devices", 0)
             return
         self.shards.append(primary)
+        if isinstance(primary, MeshShard):
+            # The fabric already spans the device set (T tree slices x
+            # S site shards inside ONE dispatch); whole-device lanes on
+            # top would double-subscribe every chip.
+            obs.gauge("fleet.devices", 1)
+            log(f"fleet: {primary.site_shards}x{primary.tree_shards} "
+                "likelihood fabric owns the device set; single mesh "
+                "lane (no whole-device lanes cut)")
+            return
         devices = list(jax.local_devices())
         if max_devices and max_devices > 0:
             devices = devices[:max_devices]

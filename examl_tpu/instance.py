@@ -255,15 +255,24 @@ class PhyloInstance:
     def batch_evaluator(self):
         """The fleet tier's batched many-tree evaluator over this
         instance (examl_tpu/fleet/batch.py), or None when the instance
-        is ineligible (-S SEV pools, sharded arenas) — one evaluator
-        per instance so its compiled-pad bookkeeping and prepared-job
-        caches persist across fleet batches."""
+        is ineligible (-S SEV pools, multi-process sharded arenas) —
+        one evaluator per instance so its compiled-pad bookkeeping and
+        prepared-job caches persist across fleet batches.  A
+        fabric-sharded instance (--mesh SxT) gets the MeshShard
+        evaluator: job stacks commit over the mesh's tree axis so one
+        dispatch spans every slice (fleet/shard.py)."""
         ev = getattr(self, "_batch_evaluator", None)
         if ev is None:
             from examl_tpu.fleet.batch import BatchEvaluator, batch_eligible
             if batch_eligible(self) is not None:
                 return None
-            ev = self._batch_evaluator = BatchEvaluator(self)
+            sh = next(iter(self.engines.values())).sharding \
+                if self.engines else None
+            if sh is not None and getattr(sh, "is_fabric", False):
+                from examl_tpu.fleet.shard import MeshShard
+                ev = self._batch_evaluator = MeshShard(self)
+            else:
+                ev = self._batch_evaluator = BatchEvaluator(self)
         return ev
 
     def invalidate_schedules(self) -> None:

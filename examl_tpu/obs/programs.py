@@ -239,9 +239,47 @@ def _memory_analysis(compiled, row: dict) -> None:
         row["peak_bytes"] = int(peak)
 
 
+# Collective kinds GSPMD can insert; the fabric's contract (ISSUE 17)
+# is that a compiled mesh program carries EXACTLY ONE all-reduce (the
+# root lnL segment-sum over `sites` — ExaML's single Allreduce) and
+# zero of every other kind.  tests/test_mesh.py pins this census.
+_COLLECTIVE_KINDS = ("all-reduce", "all-gather", "reduce-scatter",
+                     "collective-permute", "all-to-all")
+
+
+def collective_census(compiled) -> Optional[Dict[str, int]]:
+    """Count the collective ops in a Compiled's optimized HLO text
+    ({kind: n}, kinds with zero occurrences omitted), or None when the
+    backend will not serve the text.  Async pairs count once (the
+    `-start` op; `-done` is its completion, not a second collective)."""
+    import re
+    try:
+        text = compiled.as_text()
+    except Exception:                        # noqa: BLE001 — ladder rung
+        return None
+    if not text:
+        return None
+    census: Dict[str, int] = {}
+    for kind in _COLLECTIVE_KINDS:
+        n = len(re.findall(rf"\b{kind}(?:-start)?\(", text))
+        if n:
+            census[kind] = n
+    return census
+
+
+def _collectives(compiled, row: dict) -> None:
+    census = collective_census(compiled)
+    if census is None:
+        _missing("collectives", row)
+        return
+    row["collectives"] = census
+    row["collective_total"] = sum(census.values())
+
+
 def _analyze(compiled, row: dict) -> None:
     _cost_analysis(compiled, row)
     _memory_analysis(compiled, row)
+    _collectives(compiled, row)
 
 
 # -- the registry ------------------------------------------------------------
@@ -293,6 +331,9 @@ def _record(family, key, source, compile_s, lowered, compiled):
         reg.gauge(f"program.flops.{family}", row["flops"])
     if row.get("peak_bytes") is not None:
         reg.gauge(f"program.peak_bytes.{family}", row["peak_bytes"])
+    if row.get("collective_total") is not None:
+        reg.gauge(f"program.collectives.{family}",
+                  row["collective_total"])
     _stream_write(row)
     _ensure_collector()
     return row

@@ -160,6 +160,27 @@ def _world_size() -> int:
         return 1
 
 
+def _declared_mesh(args) -> Optional[dict]:
+    """The run's declared (S, T) fabric record for the manifest, or
+    None when no `--mesh`/EXAML_MESH fabric is requested (1x1 counts as
+    none).  Device-free: the bank phase must be able to stamp the
+    declaration even before the main process's fabric goes live."""
+    try:
+        from examl_tpu.parallel.launch import mesh_spec_requested
+        from examl_tpu.parallel.sharding import (declared_fabric_specs,
+                                                 parse_mesh_spec)
+        spec = mesh_spec_requested(args)
+        if not spec:
+            return None
+        s, t = parse_mesh_spec(spec)
+    except Exception:                 # noqa: BLE001 — a malformed spec
+        # is the CLI's error to raise; the bank just declines to stamp.
+        return None
+    if (s, t) == (1, 1):
+        return None
+    return declared_fabric_specs(s, t)
+
+
 # ---------------------------------------------------------------------------
 # family enumeration
 
@@ -887,6 +908,19 @@ def run_bank(args, log=lambda msg: None, timeout: Optional[float] = None,
     _STATE["banked"] = {f for f, r in report.items()
                         if r.get("status") in ("banked", "exported")}
     _STATE["enumerated"] = set(all_families)
+    decl = _declared_mesh(args)
+    if decl is not None:
+        # ISSUE 17: a `--mesh`/EXAML_MESH run's shardings are DECLARED
+        # — axis names, mesh shape, per-leaf PartitionSpecs — so the
+        # manifest records them verbatim: a relocating loader (or an
+        # operator reading the manifest) re-declares the same
+        # NamedShardings instead of trusting procid-implicit placement.
+        for r in report.values():
+            r["mesh_declared"] = decl
+        obs.inc("bank.mesh_declared", len(report))
+        log(f"bank: declared {decl['site_shards']}x"
+            f"{decl['tree_shards']} fabric shardings recorded in the "
+            "manifest for every enumerated family")
     world = _world_size()
     if world > 1:
         # ROADMAP §4 observability: workers cannot join this job's
@@ -897,7 +931,11 @@ def run_bank(args, log=lambda msg: None, timeout: Optional[float] = None,
         # instead of letting chip-round artifacts hide it in `unbanked`.
         _STATE["sharded_residual"] = True
         for r in report.values():
-            r["mesh_sharded_inprocess"] = True
+            # A mesh-built family already carries its DECLARED
+            # shardings above — `mesh_declared` supersedes the
+            # placement-implicit residual marker for those programs.
+            if "mesh_declared" not in r:
+                r["mesh_sharded_inprocess"] = True
         obs.inc("bank.sharded_residual_families", len(report))
         log(f"bank: {world}-process job — mesh-sharded program variants "
             "cannot bank in workers (no process group); their first "

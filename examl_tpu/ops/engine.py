@@ -265,6 +265,14 @@ class LikelihoodEngine:
         self.tips = self._build_tip_state()
         if save_memory:
             from examl_tpu.ops.sev import SevState
+            if sharding is not None and sharding.tree_shards > 1:
+                # The CLI names this (S, T) combination precisely; this
+                # is the engine-level backstop for embedded callers.
+                raise ValueError(
+                    f"-S cannot compose with a {sharding.site_shards}x"
+                    f"{sharding.tree_shards} fabric: the SEV pool "
+                    "holds one arena per instance, so per-job arenas "
+                    "cannot stack along the tree axis (Sx1 only)")
             self.clv = None
             gdev = sharding.num_devices if sharding is not None else 1
             local_ndev, cap_reduce = gdev, None
@@ -385,11 +393,19 @@ class LikelihoodEngine:
         # is single-process default-device engines only: mesh-sharded
         # and -S pooled executables embed placement state the bank does
         # not relocate (ROADMAP §4 keeps counting that residual).
+        # The mesh shape is part of the program family (ISSUE 17): a
+        # 2x2-fabric executable partitions differently from a 4x1 or an
+        # unsharded one even at identical avals, so the (S, T) term
+        # keys every shared-cache entry and export-artifact signature.
+        mesh_term = (None if self.sharding is None
+                     else (self.sharding.site_shards,
+                           self.sharding.tree_shards))
         self._export_identity = (
             "prog-v1", self.K, str(self.dtype), str(self.storage_dtype),
             int(self.scale_exp), str(self.fast_precision),
             self.num_parts, self.num_branch_slots, self.ntips,
-            bool(self.psr), _fastpath._knobs(), self.wave_width)
+            bool(self.psr), _fastpath._knobs(), self.wave_width,
+            mesh_term)
         self._exportable = (self.sharding is None and not save_memory
                             and self.clv is not None
                             and next(iter(self.clv.devices()))
@@ -446,6 +462,13 @@ class LikelihoodEngine:
         LikelihoodEngine._obs_seq += 1
         self._obs_tag = f"s{self.K}.e{seq}"
         self._update_arena_gauge()
+        if self.sharding is not None:
+            # Declared-mesh axis gauges (ISSUE 17): instance-wide (every
+            # engine of one run shares the mesh), rendered by
+            # tools/run_report.py and tools/top.py next to the fleet's
+            # per-slice dispatch counters.
+            obs.gauge("engine.mesh_site_shards", self.sharding.site_shards)
+            obs.gauge("engine.mesh_tree_shards", self.sharding.tree_shards)
         ref = weakref.ref(self)
 
         def _collect():
@@ -609,12 +632,17 @@ class LikelihoodEngine:
 
         from examl_tpu.parallel.sharding import SITE_AXIS as AX
 
+        # jax.shard_map graduated from jax.experimental after 0.4.x.
+        shard_map = getattr(jax, "shard_map", None)
+        if shard_map is None:
+            from jax.experimental.shard_map import shard_map
+
         mesh = self.sharding.mesh
         REP = P()
 
         def wrap(impl, in_specs, out_specs, donate=()):
-            mapped = jax.shard_map(impl, mesh=mesh, in_specs=in_specs,
-                                   out_specs=out_specs)
+            mapped = shard_map(impl, mesh=mesh, in_specs=in_specs,
+                               out_specs=out_specs)
             return jax.jit(mapped, donate_argnums=donate)
 
         return {

@@ -24,8 +24,9 @@ from __future__ import annotations
 import os
 from typing import Optional
 
-from examl_tpu.parallel.sharding import (SiteSharding, make_mesh,
-                                         site_sharding)
+from examl_tpu.parallel.sharding import (SiteSharding, fabric_sharding,
+                                         make_fabric_mesh, make_mesh,
+                                         parse_mesh_spec, site_sharding)
 
 
 def add_launch_args(parser) -> None:
@@ -40,6 +41,16 @@ def add_launch_args(parser) -> None:
     g.add_argument("--single-device", action="store_true",
                    help="disable site-axis sharding even when several "
                         "devices are visible")
+    g.add_argument("--mesh", dest="mesh", default=None, metavar="SxT",
+                   help="declared (sites, tree) likelihood fabric: "
+                        "shard each tree's packed site blocks over S "
+                        "devices AND the fleet's job axis over T "
+                        "device slices of the same mesh (e.g. "
+                        "--mesh 4x2 on 8 devices).  T>1 requires a "
+                        "fleet mode (-b/-N/--serve); Sx1 is the "
+                        "classic site sharding with an explicit "
+                        "shape.  EXAML_MESH=SxT is the env "
+                        "equivalent (the flag wins)")
 
 
 def init_distributed(args, log=lambda msg: None) -> None:
@@ -144,10 +155,40 @@ def enable_process_tracing(trace_dir: str,
     return path
 
 
+def mesh_spec_requested(args) -> Optional[str]:
+    """The raw SxT mesh spec in force, or None: the --mesh flag wins
+    over EXAML_MESH (registered in tools/graftlint/envregistry.py)."""
+    flag = getattr(args, "mesh", None)
+    if flag:
+        return flag
+    return os.environ.get("EXAML_MESH") or None
+
+
 def select_sharding(args, save_memory: bool,
                     log=lambda msg: None) -> Optional[SiteSharding]:
     """A site-axis sharding over every visible device, or None for the
-    single-device case (-S shards too: per-device pool regions)."""
+    single-device case (-S shards too: per-device pool regions).
+
+    With a declared mesh (`--mesh SxT` / EXAML_MESH) the result is the
+    2-D (sites, tree) fabric instead: S site shards per tree slice, T
+    tree slices, on exactly S*T devices.  A 1x1 mesh is an explicit
+    single-device run (the parity-matrix anchor)."""
+    spec = mesh_spec_requested(args)
+    if spec is not None:
+        s, t = parse_mesh_spec(spec)          # caller pre-validated; a
+        if s == t == 1:                       # raise here is a bug trap
+            return None
+        import jax
+
+        sh = fabric_sharding(make_fabric_mesh(s, t))
+        if save_memory:
+            log(f"-S (SEV) sharded: per-device CLV pool regions over "
+                f"{s} devices (mesh {s}x{t})")
+        else:
+            log(f"likelihood fabric {s}x{t}: {s} site shard(s) x {t} "
+                f"tree slice(s) over {s * t} of {len(jax.devices())} "
+                "devices")
+        return sh
     if getattr(args, "single_device", False):
         return None
     import jax

@@ -16,18 +16,32 @@ Multi-host scale-out uses the same mesh: `jax.distributed` process groups
 present a global device list, and the "sites" axis spans all chips; the
 only cross-host traffic is the small lnL reduction, riding DCN exactly as
 the reference's Allreduce rides the interconnect.
+
+**The likelihood fabric (ISSUE 17 / ROADMAP §7)** adds a second named
+axis: a 2-D `Mesh(devices.reshape(S, T), ("sites", "tree"))` composes
+the site axis with the fleet's tree-batch axis on the SAME devices.
+Engine tensors keep their site-only `PartitionSpec`s (unnamed axes
+replicate, so each tree slice holds the whole model and its site
+shards — the reference's invariant per rank); the fleet's stacked
+per-job leaves carry `P("tree", ...)` on the leading job axis
+(`fleet/shard.py: MeshShard`).  GSPMD partitions jobs over `tree` and
+each job's blocks over `sites`; the root lnL segment-sum stays the one
+cross-shard collective (an all-reduce over `sites` — ExaML's single
+Allreduce), and the per-job outputs shard over `tree` with no
+tree-axis collective at all.
 """
 
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Optional, Sequence
+from typing import Optional, Sequence, Tuple
 
 import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 SITE_AXIS = "sites"
+TREE_AXIS = "tree"
 
 
 @dataclass
@@ -41,6 +55,11 @@ class SiteSharding:
       sites   [B, lane]              — blocks on axis 0 (weights)
       blocks  [B]                    — blocks on axis 0 (block_part)
       replicated                     — models / traversal descriptors
+
+    The mesh may be 1-D ("sites" only) or the 2-D (sites, tree) fabric;
+    the specs above never mention the tree axis, so on a fabric every
+    tree slice replicates the engine state over its site shards — the
+    composition contract `fleet/shard.py: MeshShard` builds on.
     """
     mesh: Mesh
     clv: NamedSharding
@@ -51,7 +70,28 @@ class SiteSharding:
 
     @property
     def num_devices(self) -> int:
-        return self.mesh.devices.size
+        """SITE-axis shard count — the divisor of the packed block axis
+        (block_multiple padding, -S region counts).  Identical to the
+        mesh size on a 1-D mesh; on the 2-D fabric the tree axis does
+        not split blocks, so it must not inflate this number."""
+        return int(dict(zip(self.mesh.axis_names,
+                            self.mesh.devices.shape))[SITE_AXIS])
+
+    @property
+    def site_shards(self) -> int:
+        return self.num_devices
+
+    @property
+    def tree_shards(self) -> int:
+        shape = dict(zip(self.mesh.axis_names, self.mesh.devices.shape))
+        return int(shape.get(TREE_AXIS, 1))
+
+    @property
+    def is_fabric(self) -> bool:
+        """True when the mesh carries the named tree axis (even T=1):
+        the fleet then commits its job stacks over `tree` instead of
+        cutting per-device lanes."""
+        return TREE_AXIS in self.mesh.axis_names
 
 
 def make_mesh(devices: Optional[Sequence[jax.Device]] = None,
@@ -78,3 +118,98 @@ def site_sharding(mesh: Mesh) -> SiteSharding:
 
 def default_site_sharding(n_devices: Optional[int] = None) -> SiteSharding:
     return site_sharding(make_mesh(n_devices=n_devices))
+
+
+# -- the (sites, tree) fabric (ISSUE 17 / ROADMAP §7) ------------------------
+
+
+def parse_mesh_spec(spec: str) -> Tuple[int, int]:
+    """`--mesh SxT` / `EXAML_MESH=SxT` -> (site_shards, tree_shards).
+    Accepts 'x' or 'X' as the separator; both axes must be positive."""
+    text = str(spec).strip().lower()
+    parts = text.split("x")
+    if len(parts) != 2:
+        raise ValueError(
+            f"mesh spec {spec!r} is not SxT (e.g. 2x2: 2 site shards "
+            "x 2 tree shards)")
+    try:
+        s, t = int(parts[0]), int(parts[1])
+    except ValueError:
+        raise ValueError(f"mesh spec {spec!r}: both axes must be integers")
+    if s < 1 or t < 1:
+        raise ValueError(f"mesh spec {spec!r}: both axes must be >= 1")
+    return s, t
+
+
+def make_fabric_mesh(site_shards: int, tree_shards: int,
+                     devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """The 2-D (sites, tree) device mesh: S*T devices reshaped so the
+    site axis is outermost (site shards of one tree slice sit on
+    consecutive devices — on real topologies that keeps the lnL
+    all-reduce, the fabric's only collective, on neighbor links)."""
+    if devices is None:
+        devices = jax.devices()
+    need = site_shards * tree_shards
+    if need > len(devices):
+        raise ValueError(
+            f"mesh {site_shards}x{tree_shards} needs {need} devices; "
+            f"only {len(devices)} visible (raise "
+            "--xla_force_host_platform_device_count on CPU, or shrink "
+            "the mesh)")
+    arr = np.asarray(devices[:need]).reshape(site_shards, tree_shards)
+    return Mesh(arr, (SITE_AXIS, TREE_AXIS))
+
+
+def fabric_sharding(mesh: Mesh) -> SiteSharding:
+    """Engine-tensor shardings over the 2-D fabric: identical specs to
+    `site_sharding` (site axis only — the tree axis replicates engine
+    state), just declared on the fabric mesh so fleet job stacks
+    committed with `P(TREE_AXIS, ...)` compose in one jitted dispatch."""
+    return site_sharding(mesh)
+
+
+def declared_specs(sharding: SiteSharding) -> dict:
+    """The fabric's declared-sharding record (ROADMAP §4's
+    declared-sharding half): axis names, mesh shape and per-leaf
+    PartitionSpecs, JSON-ready for `bank_manifest.json` — a relocating
+    loader re-declares the same NamedShardings from this block instead
+    of trusting procid-implicit placement."""
+    leaf_specs = {
+        "clv": str(sharding.clv.spec),
+        "scaler": str(sharding.scaler.spec),
+        "sites": str(sharding.sites.spec),
+        "blocks": str(sharding.blocks.spec),
+        "replicated": str(sharding.replicated.spec),
+    }
+    if sharding.is_fabric:
+        leaf_specs["fleet_jobs"] = str(P(TREE_AXIS))
+        leaf_specs["fleet_clv"] = str(P(TREE_AXIS, None, SITE_AXIS))
+    return {
+        "axis_names": list(sharding.mesh.axis_names),
+        "mesh_shape": [int(d) for d in sharding.mesh.devices.shape],
+        "site_shards": sharding.site_shards,
+        "tree_shards": sharding.tree_shards,
+        "leaf_specs": leaf_specs,
+    }
+
+
+def declared_fabric_specs(site_shards: int, tree_shards: int) -> dict:
+    """`declared_specs` without constructing the mesh: byte-identical
+    JSON for an (S, T) fabric, computable in contexts that must not
+    touch devices (the bank's manifest stamping runs before/without the
+    main process's fabric being live)."""
+    return {
+        "axis_names": [SITE_AXIS, TREE_AXIS],
+        "mesh_shape": [int(site_shards), int(tree_shards)],
+        "site_shards": int(site_shards),
+        "tree_shards": int(tree_shards),
+        "leaf_specs": {
+            "clv": str(P(None, SITE_AXIS)),
+            "scaler": str(P(None, SITE_AXIS)),
+            "sites": str(P(SITE_AXIS)),
+            "blocks": str(P(SITE_AXIS)),
+            "replicated": str(P()),
+            "fleet_jobs": str(P(TREE_AXIS)),
+            "fleet_clv": str(P(TREE_AXIS, None, SITE_AXIS)),
+        },
+    }

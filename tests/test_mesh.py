@@ -1,0 +1,338 @@
+"""The (sites, tree) likelihood fabric (ISSUE 17 / ROADMAP §7).
+
+The composition contract, pinned four ways:
+
+* **Parity matrix**: f64 lnL across `1x1` / `Sx1` / `1xT` / `SxT`
+  fabrics — GAMMA, `-M` per-partition branches and PSR — agrees at the
+  same pinned tolerances the 8-way battery (tests/test_sharding.py)
+  uses; the batched MeshShard path additionally matches the plain
+  BatchEvaluator bit for bit.
+* **One collective**: every compiled fabric program's optimized-HLO
+  census (obs/programs.py: collective_census) is exactly
+  `{"all-reduce": 1}` — the root lnL segment-sum over `sites`, ExaML's
+  single Allreduce — with zero all-gather / reduce-scatter /
+  collective-permute / all-to-all, and nothing over the tree axis.
+* **Flag hygiene**: the CLI's mesh validation names every unsupported
+  `(S, T)` combination precisely (SEV x T>1, mesh x fleet-devices,
+  mesh x single-device, T>1 without a fleet mode) at argument time,
+  and the engine backstops SEV x fabric for API users.
+* **Observability**: shape gauges and per-tree-slice dispatch/job
+  counters land, so tools/run_report.py and tools/top.py can render
+  the fabric (GL005 pins the names both directions).
+
+conftest.py forces 8 virtual CPU devices, so every shape here fits.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from examl_tpu.instance import PhyloInstance
+from examl_tpu.io.alignment import build_alignment_data
+from examl_tpu.parallel.sharding import (declared_fabric_specs,
+                                         declared_specs,
+                                         default_site_sharding,
+                                         fabric_sharding, make_fabric_mesh,
+                                         parse_mesh_spec)
+
+pytestmark = pytest.mark.skipif(len(jax.devices()) < 8,
+                                reason="needs 8 (virtual) devices")
+
+
+def _synth_data(ntaxa=12, nsites=300, seed=7, specs=None):
+    rng = np.random.default_rng(seed)
+    cur = rng.integers(0, 4, nsites)
+    seqs = []
+    for _ in range(ntaxa):
+        flip = rng.random(nsites) < 0.15
+        cur = np.where(flip, rng.integers(0, 4, nsites), cur)
+        seqs.append("".join("ACGT"[c] for c in cur))
+    return build_alignment_data([f"t{i}" for i in range(ntaxa)], seqs,
+                                specs=specs)
+
+
+@pytest.fixture(scope="module")
+def data12():
+    return _synth_data()
+
+
+def _fabric(s, t):
+    return fabric_sharding(make_fabric_mesh(s, t))
+
+
+# -- the fabric's shape algebra ----------------------------------------------
+
+
+def test_parse_mesh_spec():
+    assert parse_mesh_spec("2x2") == (2, 2)
+    assert parse_mesh_spec(" 4X1 ") == (4, 1)
+    for bad in ("2", "2x2x2", "0x2", "2x-1", "axb"):
+        with pytest.raises(ValueError):
+            parse_mesh_spec(bad)
+
+
+def test_fabric_mesh_device_budget():
+    """An over-subscribed shape fails with the device arithmetic in the
+    message, not a reshape traceback."""
+    with pytest.raises(ValueError, match="needs 16 devices"):
+        make_fabric_mesh(4, 4)
+
+
+def test_fabric_shape_properties():
+    sh = _fabric(2, 2)
+    assert sh.is_fabric
+    assert sh.site_shards == 2 and sh.tree_shards == 2
+    # num_devices is the SITE-axis extent: the tree axis must not
+    # inflate block_multiple / SEV divisibility arithmetic.
+    assert sh.num_devices == 2
+    one_d = default_site_sharding(4)
+    assert not one_d.is_fabric
+    assert one_d.tree_shards == 1 and one_d.num_devices == 4
+
+
+def test_declared_specs_roundtrip():
+    """The manifest's declared-sharding block is byte-identical whether
+    derived from a live fabric or computed device-free (the bank's
+    path), and a 1-D mesh declares no fleet leaves."""
+    live = declared_specs(_fabric(2, 2))
+    assert live == declared_fabric_specs(2, 2)
+    assert live["axis_names"] == ["sites", "tree"]
+    assert live["mesh_shape"] == [2, 2]
+    assert "fleet_jobs" in live["leaf_specs"]
+    one_d = declared_specs(default_site_sharding(4))
+    assert one_d["tree_shards"] == 1
+    assert "fleet_jobs" not in one_d["leaf_specs"]
+
+
+def test_bank_declared_mesh(monkeypatch):
+    """The bank's manifest stamp is device-free and declines (returns
+    None) for no-spec / 1x1 / malformed specs — a bad spec is the
+    CLI's error to raise, not the bank's."""
+    import argparse
+
+    from examl_tpu.ops.bank import _declared_mesh
+
+    ns = lambda m: argparse.Namespace(mesh=m)  # noqa: E731
+    monkeypatch.delenv("EXAML_MESH", raising=False)
+    assert _declared_mesh(ns(None)) is None
+    assert _declared_mesh(ns("1x1")) is None
+    assert _declared_mesh(ns("bogus")) is None
+    assert _declared_mesh(ns("2x2")) == declared_fabric_specs(2, 2)
+    # EXAML_MESH backs the flag; the flag wins.
+    monkeypatch.setenv("EXAML_MESH", "4x2")
+    assert _declared_mesh(ns(None)) == declared_fabric_specs(4, 2)
+    assert _declared_mesh(ns("2x1")) == declared_fabric_specs(2, 1)
+
+
+# -- flag hygiene: every unsupported (S, T) names itself ----------------------
+
+
+def test_cli_mesh_flag_errors(tmp_path):
+    from examl_tpu.cli.main import main as cli_main
+
+    base = ["-s", str(tmp_path / "missing.binary"), "-n", "X",
+            "-w", str(tmp_path)]
+
+    # All mesh validation fires at argparse time (exit 2), before any
+    # file load — a dummy -s path proves that ordering too.
+    for extra in (["--mesh", "2"],                    # malformed spec
+                  ["--mesh", "2x2", "--single-device", "-N", "4"],
+                  ["--mesh", "1x2"],                  # T>1, no fleet mode
+                  ["--mesh", "2x2", "-S", "-N", "4"],  # SEV x T>1
+                  ["--mesh", "2x2", "-N", "4",
+                   "--fleet-devices", "2"]):          # fabric owns devices
+        with pytest.raises(SystemExit) as ei:
+            cli_main(base + extra)
+        assert ei.value.code == 2
+
+
+def test_cli_fleet_sev_error_names_shape(tmp_path, capsys):
+    """The blanket fleet -S error names the (S, T) combination that
+    cannot compose — the operator sees the mesh router looked and
+    declined, not that routing is missing."""
+    from examl_tpu.cli.main import main as cli_main
+
+    with pytest.raises(SystemExit):
+        cli_main(["-s", str(tmp_path / "missing.binary"), "-n", "X",
+                  "-w", str(tmp_path), "-S", "-N", "4"])
+    err = capsys.readouterr().err
+    assert "(S=1, T=J)" in err
+    with pytest.raises(SystemExit):
+        cli_main(["-s", str(tmp_path / "missing.binary"), "-n", "X",
+                  "-w", str(tmp_path), "-S", "-N", "4", "--mesh", "2x2"])
+    err = capsys.readouterr().err
+    assert "2x2" in err and "-S" in err
+
+
+def test_sev_fabric_engine_guard(data12):
+    """API users bypassing the CLI hit the engine's backstop: SEV
+    pools cannot stack per-job arenas along the tree axis."""
+    with pytest.raises(ValueError, match="1x2 fabric"):
+        PhyloInstance(data12, save_memory=True, sharding=_fabric(1, 2))
+    # Sx1 composes: the site axis divides the SEV pool exactly like a
+    # 1-D mesh.
+    inst = PhyloInstance(data12, save_memory=True, block_multiple=2,
+                         sharding=_fabric(2, 1))
+    t = inst.random_tree(seed=3)
+    ref = PhyloInstance(data12, save_memory=True)
+    assert inst.evaluate(t, full=True) == pytest.approx(
+        ref.evaluate(ref.random_tree(seed=3), full=True),
+        rel=1e-12, abs=1e-7)
+
+
+# -- the non-slow representative: 2x2 parity + the one-collective pin --------
+
+
+def test_fabric_parity_and_single_collective(data12):
+    """One 2x2 fabric: solo lnL parity with 1x1, MeshShard batch parity
+    with the plain BatchEvaluator, exactly one all-reduce in every
+    compiled fabric program, and the shape/slice evidence the report
+    renders.  (The full shape x model matrix is the slow battery
+    below; CI additionally runs tools/mesh_smoke.py through the real
+    CLI.)"""
+    from examl_tpu import obs
+    from examl_tpu.fleet.shard import MeshShard
+    from examl_tpu.obs import programs
+
+    # The whole 1x1 baseline runs BEFORE the observatory reset, so the
+    # censused table below holds ONLY fabric-compiled programs (a plain
+    # single-device program legitimately carries zero collectives).
+    inst1 = PhyloInstance(data12)
+    lnl1 = inst1.evaluate(inst1.random_tree(seed=3), full=True)
+    ev1 = inst1.batch_evaluator()
+    groups1 = {}
+    for s in range(3):
+        p1 = ev1.prepare(inst1.random_tree(seed=s))
+        groups1.setdefault(p1.key, []).append(p1)
+    out1 = {key: np.asarray(ev1.eval_batch(g))
+            for key, g in groups1.items()}
+
+    obs.reset()
+    programs.reset()
+    sh = _fabric(2, 2)
+    inst = PhyloInstance(data12, block_multiple=2, sharding=sh)
+    lnl = inst.evaluate(inst.random_tree(seed=3), full=True)
+    assert lnl == pytest.approx(lnl1, rel=1e-12, abs=1e-7)
+
+    ev = inst.batch_evaluator()
+    assert isinstance(ev, MeshShard)
+    assert ev.site_shards == 2 and ev.tree_shards == 2
+    groups = {}
+    for s in range(3):
+        p = ev.prepare(inst.random_tree(seed=s))
+        groups.setdefault(p.key, []).append(p)
+    assert groups.keys() == groups1.keys()  # same trees -> same profiles
+    for key, g in groups.items():
+        out = np.asarray(ev.eval_batch(g))
+        np.testing.assert_allclose(out, out1[key], rtol=1e-10, atol=1e-7)
+
+    # The jpad contract: pads are tree-axis multiples, so GSPMD never
+    # pads the job axis itself (which would silently replicate rows).
+    for pads in ev._jpads.values():
+        assert all(p % ev.tree_shards == 0 for p in pads)
+
+    # Exactly one cross-shard collective per compiled fabric program:
+    # the site-axis lnL all-reduce, nothing else, and nothing over the
+    # tree axis (which would show as a second collective here).
+    rows = [r for r in programs.table()
+            if r.get("collectives") is not None]
+    assert rows, "observatory recorded no analyzed fabric programs"
+    for r in rows:
+        assert r["collectives"] == {"all-reduce": 1}, \
+            (r["family"], r["collectives"])
+        assert r["collective_total"] == 1
+
+    # Shape gauges + per-slice counters (the names run_report/top
+    # render; GL005 keeps them honest both directions).
+    snap = obs.snapshot()
+    g, c = snap.get("gauges", {}), snap.get("counters", {})
+    assert g.get("engine.mesh_site_shards") == 2
+    assert g.get("engine.mesh_tree_shards") == 2
+    assert g.get("fleet.mesh_tree_shards") == 2
+    assert c.get("fleet.mesh_batches", 0) >= 1
+    assert c.get("fleet.mesh_slice_dispatches.t0", 0) >= 1
+    assert c.get("fleet.mesh_slice_dispatches.t1", 0) >= 1
+    assert c.get("fleet.mesh_slice_jobs.t0", 0) >= 1
+
+
+# -- the full parity matrix (slow tier; mesh_smoke covers CI cadence) --------
+
+
+@pytest.mark.slow
+def test_parity_matrix_gamma(data12):
+    inst1 = PhyloInstance(data12)
+    lnl1 = inst1.evaluate(inst1.random_tree(seed=3), full=True)
+    for s, t in ((2, 1), (1, 2), (2, 2), (4, 2)):
+        inst = PhyloInstance(data12, block_multiple=max(1, s),
+                             sharding=_fabric(s, t))
+        lnl = inst.evaluate(inst.random_tree(seed=3), full=True)
+        assert lnl == pytest.approx(lnl1, rel=1e-12, abs=1e-7), (s, t)
+
+
+@pytest.mark.slow
+def test_parity_matrix_multipartition():
+    """-M per-partition branch lengths x two partitions on the fabric."""
+    from examl_tpu.io.partitions import parse_partition_file
+
+    import tempfile
+    with tempfile.NamedTemporaryFile("w", suffix=".model",
+                                     delete=False) as f:
+        f.write("DNA, g1 = 1-150\nDNA, g2 = 151-300\n")
+        mp = f.name
+    data = _synth_data(specs=parse_partition_file(mp))
+    inst1 = PhyloInstance(data, per_partition_branches=True)
+    lnl1 = inst1.evaluate(inst1.random_tree(seed=3), full=True)
+    for s, t in ((2, 1), (1, 2), (2, 2)):
+        inst = PhyloInstance(data, per_partition_branches=True,
+                             block_multiple=max(1, s),
+                             sharding=_fabric(s, t))
+        lnl = inst.evaluate(inst.random_tree(seed=3), full=True)
+        assert lnl == pytest.approx(lnl1, rel=1e-12, abs=1e-7), (s, t)
+
+
+@pytest.mark.slow
+def test_parity_matrix_psr(data12):
+    inst1 = PhyloInstance(data12, rate_model="PSR")
+    lnl1 = inst1.evaluate(inst1.random_tree(seed=3), full=True)
+    for s, t in ((2, 1), (1, 2), (2, 2)):
+        inst = PhyloInstance(data12, rate_model="PSR",
+                             block_multiple=max(1, s),
+                             sharding=_fabric(s, t))
+        lnl = inst.evaluate(inst.random_tree(seed=3), full=True)
+        assert lnl == pytest.approx(lnl1, rel=1e-12, abs=1e-7), (s, t)
+
+
+@pytest.mark.slow
+def test_cli_mesh_run_parity(tmp_path):
+    """The real CLI: -N multi-start on --mesh 2x2 vs the 1x1 baseline,
+    per-job lnL from the fleet results tables (the same drive
+    tools/mesh_smoke.py gives CI, here against the slow tier's full
+    assertion budget)."""
+    from examl_tpu.cli.main import main as cli_main
+    from examl_tpu.io.bytefile import write_bytefile
+
+    data = _synth_data(ntaxa=16, nsites=400)
+    write_bytefile(str(tmp_path / "a.binary"), data)
+
+    def run(tag, extra):
+        wd = tmp_path / tag
+        rc = cli_main(["-s", str(tmp_path / "a.binary"), "-n", tag,
+                       "-w", str(wd), "-N", "6"] + extra)
+        assert rc == 0
+        out = {}
+        for line in (wd / f"ExaML_fleet.{tag}").read_text().splitlines():
+            if line.startswith("#"):
+                continue
+            parts = line.split()
+            out[parts[0]] = float(parts[5])
+        return out
+
+    base = run("B11", [])
+    mesh = run("M22", ["--mesh", "2x2"])
+    assert base.keys() == mesh.keys()
+    # The results table reports lnL at f32 granularity: two f32 ULPs of
+    # |lnL| is reporting-precision parity (the f64 bit-level check is
+    # the in-process battery above).
+    for j in base:
+        assert mesh[j] == pytest.approx(
+            base[j], abs=max(2e-4, 2 * abs(base[j]) * 2.0 ** -23)), j

@@ -51,10 +51,14 @@ class FakeMem:
 
 class FakeCompiled:
     """cost= list-of-dicts (jaxlib's shape), a plain dict, None, [] or
-    an exception instance (raised); mem= FakeMem, None or exception."""
+    an exception instance (raised); mem= FakeMem, None or exception;
+    text= optimized-HLO text for the collective census (default: one
+    all-reduce, the fabric's invariant shape) or an exception."""
 
-    def __init__(self, cost=None, mem=None):
-        self._cost, self._mem = cost, mem
+    _HLO = 'ar = f64[] all-reduce(f64[] x), replica_groups={}'
+
+    def __init__(self, cost=None, mem=None, text=_HLO):
+        self._cost, self._mem, self._text = cost, mem, text
 
     def cost_analysis(self):
         if isinstance(self._cost, Exception):
@@ -65,6 +69,11 @@ class FakeCompiled:
         if isinstance(self._mem, Exception):
             raise self._mem
         return self._mem
+
+    def as_text(self):
+        if isinstance(self._text, Exception):
+            raise self._text
+        return self._text
 
 
 class FakeLowered:
@@ -95,12 +104,15 @@ def test_record_full_analyses_populates_row_and_gauges():
     assert (row["argument_bytes"], row["output_bytes"],
             row["temp_bytes"]) == (100, 50, 25)
     assert row["peak_bytes"] == 175          # structural: arg+out+temp
+    assert row["collectives"] == {"all-reduce": 1}
+    assert row["collective_total"] == 1
     assert "missing" not in row
     snap = obs.registry().snapshot_light()
     assert snap["gauges"]["program.count"] == 1
     assert snap["gauges"]["program.bytes_accessed.fast"] == 4e5
     assert snap["gauges"]["program.flops.fast"] == 1e6
     assert snap["gauges"]["program.peak_bytes.fast"] == 175
+    assert snap["gauges"]["program.collectives.fast"] == 1
     assert [r["family"] for r in programs.table()] == ["fast"]
 
 
@@ -158,11 +170,14 @@ def test_record_never_raises_on_hostile_compiled():
 
     c0 = _counter("program.analysis_missing.cost_analysis")
     m0 = _counter("program.analysis_missing.memory_analysis")
+    k0 = _counter("program.analysis_missing.collectives")
     row = programs.record("fast", "k", "fresh", 0.1, compiled=Hostile())
     assert row is not None                   # degraded row, not a crash
-    assert set(row["missing"]) == {"cost_analysis", "memory_analysis"}
+    assert set(row["missing"]) == {"cost_analysis", "memory_analysis",
+                                   "collectives"}
     assert _counter("program.analysis_missing.cost_analysis") == c0 + 1
     assert _counter("program.analysis_missing.memory_analysis") == m0 + 1
+    assert _counter("program.analysis_missing.collectives") == k0 + 1
 
 
 def test_off_mode_disables_everything(monkeypatch):

@@ -667,11 +667,12 @@ def test_fresh_leased_run_clears_stale_base_journal(tmp_path):
 
 
 def test_cli_fleet_sev_error_names_issue(tmp_path, capsys):
-    """-S under a fleet mode stays a PRECISE error naming ISSUE 14 as
-    the one unrouted combination."""
+    """-S under a fleet mode stays a PRECISE error: since the mesh
+    fabric (ISSUE 17) it names the (S, T) combination that cannot
+    compose — the SEV pool holds one arena per instance."""
     import examl_tpu.cli.main as cli
     with pytest.raises(SystemExit):
         cli.main(["-s", "x.binary", "-n", "T", "-N", "2", "-S",
                   "-w", str(tmp_path)])
     err = capsys.readouterr().err
-    assert "ISSUE 14" in err and "SEV" in err
+    assert "(S=1, T=J)" in err and "SEV" in err

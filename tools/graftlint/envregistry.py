@@ -170,6 +170,11 @@ ENV_REGISTRY = {
         "doc": "readme",
         "note": "promote a recurring novel profile to the specialized "
                 "batched program after K jobs."},
+    "EXAML_MESH": {
+        "doc": "readme",
+        "note": "SxT likelihood-fabric mesh (same as --mesh; the flag "
+                "wins): S site shards x T tree slices over S*T "
+                "devices; 1x1 disables."},
     "EXAML_FLEET_UNIBATCH": {
         "doc": "readme",
         "note": "1 batches mixed-profile novel jobs through the "

@@ -1,0 +1,201 @@
+#!/usr/bin/env python
+"""Likelihood-fabric smoke (ISSUE 17 / ROADMAP §7): the real CLI on a
+declared (sites, tree) mesh.
+
+Runs the same multi-start job set through the real CLI twice — the
+1x1 baseline and `--mesh SxT` over S*T forced host devices — asserts
+per-job lnL parity from the ExaML_fleet results tables, then asserts
+the fabric's collective invariant from the program observatory's
+compiled-HLO census: every mesh program carries EXACTLY ONE all-reduce
+(the root lnL segment-sum over `sites` — ExaML's single Allreduce) and
+zero all-gather / reduce-scatter / collective-permute / all-to-all.
+
+Emits a SHARD_BENCH-style artifact recording the S×T shape, per-axis
+occupancy (tree-slice dispatch/job counters + site-shard count), warm
+walls both ways, and the census — the honesty discipline of
+shard_smoke.py: forced host devices time-share the cores, so the walls
+are recorded but the PASS verdict rides on parity + the collective
+census, which are host-independent.
+
+    python tools/mesh_smoke.py                          # CI smoke (2x2)
+    python tools/mesh_smoke.py --mesh 2x2 --jobs 8 --out MESH_BENCH.json
+
+Exit 0 = parity + single-collective invariant + per-slice evidence
+present; 1 otherwise.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def _force_devices(n: int) -> None:
+    """Force n XLA host devices — must run before jax imports."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n}"
+        ).strip()
+
+
+def _read_fleet_table(path: str) -> dict:
+    """{job_id: lnl} from an ExaML_fleet results table."""
+    out = {}
+    with open(path) as f:
+        for line in f:
+            if line.startswith("#") or not line.strip():
+                continue
+            parts = line.split()
+            out[parts[0]] = float(parts[5])
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--mesh", default="2x2", metavar="SxT")
+    ap.add_argument("--jobs", type=int, default=8)
+    ap.add_argument("--ntaxa", type=int, default=16)
+    ap.add_argument("--nsites", type=int, default=400)
+    ap.add_argument("--workdir", default=None)
+    ap.add_argument("--out", default=None)
+    args = ap.parse_args(argv)
+
+    from examl_tpu.parallel.sharding import parse_mesh_spec
+    s_sh, t_sh = parse_mesh_spec(args.mesh)
+    _force_devices(max(2, s_sh * t_sh))
+
+    import tempfile
+
+    import numpy as np
+
+    from examl_tpu import obs
+    from examl_tpu.cli.main import main as cli_main
+    from examl_tpu.io.alignment import build_alignment_data
+    from examl_tpu.io.bytefile import write_bytefile
+    from examl_tpu.obs import programs
+
+    wd = args.workdir or tempfile.mkdtemp(prefix="examl_mesh_smoke.")
+    rng = np.random.default_rng(7)
+    cur = rng.integers(0, 4, args.nsites)
+    seqs = []
+    for _ in range(args.ntaxa):
+        flip = rng.random(args.nsites) < 0.15
+        cur = np.where(flip, rng.integers(0, 4, args.nsites), cur)
+        seqs.append("".join("ACGT"[c] for c in cur))
+    data = build_alignment_data(
+        [f"t{i}" for i in range(args.ntaxa)], seqs)
+    binfile = os.path.join(wd, "a.binary")
+    write_bytefile(binfile, data)
+
+    def run(tag: str, extra):
+        run_wd = os.path.join(wd, tag)
+        t0 = time.perf_counter()
+        rc = cli_main(["-s", binfile, "-n", tag, "-w", run_wd,
+                       "-N", str(args.jobs)] + extra)
+        wall = time.perf_counter() - t0
+        assert rc == 0, f"CLI run {tag} exited {rc}"
+        table = _read_fleet_table(
+            os.path.join(run_wd, f"ExaML_fleet.{tag}"))
+        assert len(table) == args.jobs, \
+            f"{tag}: {len(table)} of {args.jobs} jobs in the table"
+        return table, wall
+
+    # Baseline first (1x1: the classic single-device fleet path), then
+    # the fabric run — its observatory rows and mesh counters are the
+    # freshest state when we census below.
+    base, wall1 = run("BASE", [])
+    obs.reset()
+    programs.reset()
+    mesh, wall_m = run("MESH", ["--mesh", args.mesh])
+
+    # The results table reports each job's lnL at f32 granularity, so
+    # the cross-run comparison tolerates two f32 ULPs of |lnL| (the
+    # fabric's reordered site reduction can land one rounding boundary
+    # away); the bit-level f64 parity lives in tests/test_mesh.py's
+    # in-process battery (rtol 1e-10).
+    max_abs = max(abs(base[j] - mesh[j]) for j in base)
+    parity_ok = all(
+        abs(base[j] - mesh[j]) <= max(2e-4, 2 * abs(base[j]) * 2.0 ** -23)
+        for j in base)
+
+    # The collective census: every analyzed program the fabric run
+    # compiled must carry exactly one all-reduce and nothing else.
+    census_rows = [r for r in programs.table()
+                   if r.get("collectives") is not None]
+    bad_census = [
+        (r["family"], r["collectives"]) for r in census_rows
+        if r["collectives"] != {"all-reduce": 1}]
+    snap = obs.snapshot()
+    counters = snap.get("counters", {})
+    gauges = snap.get("gauges", {})
+    slice_dispatches = {
+        k.rsplit(".", 1)[-1]: int(v) for k, v in counters.items()
+        if k.startswith("fleet.mesh_slice_dispatches.")}
+    slice_jobs = {
+        k.rsplit(".", 1)[-1]: int(v) for k, v in counters.items()
+        if k.startswith("fleet.mesh_slice_jobs.")}
+
+    artifact = {
+        "bench": "mesh",
+        "backend": "cpu-forced-host-devices",
+        "mesh": f"{s_sh}x{t_sh}",
+        "site_shards": int(gauges.get("engine.mesh_site_shards", s_sh)),
+        "tree_shards": int(gauges.get("fleet.mesh_tree_shards", t_sh)),
+        "jobs": args.jobs,
+        "ntaxa": args.ntaxa,
+        "nsites": args.nsites,
+        "wall_1x1_s": round(wall1, 3),
+        "wall_mesh_s": round(wall_m, 3),
+        "lnl_max_abs_diff": max_abs,
+        "lnl_parity": parity_ok,
+        "mesh_batches": int(counters.get("fleet.mesh_batches", 0)),
+        "slice_dispatches": slice_dispatches,
+        "slice_jobs": slice_jobs,
+        "slice_occupancy": {
+            t: (slice_jobs.get(t, 0) / d if d else 0.0)
+            for t, d in slice_dispatches.items()},
+        "programs_censused": len(census_rows),
+        "collective_census_clean": not bad_census,
+        "collective_census_violations": bad_census,
+        "note": ("forced host devices time-share the cores: walls are "
+                 "recorded, the verdict rides on lnL parity + the "
+                 "one-all-reduce census (host-independent)"),
+    }
+    print(json.dumps(artifact, indent=2, sort_keys=True))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(artifact, f, indent=2, sort_keys=True)
+        print(f"mesh bench row -> {args.out}")
+
+    ok = True
+    if not parity_ok:
+        print(f"FAIL: lnL parity broken (max abs diff {max_abs})")
+        ok = False
+    if not census_rows:
+        print("FAIL: no analyzed programs to census (observatory off?)")
+        ok = False
+    if bad_census:
+        print(f"FAIL: collective census violations: {bad_census}")
+        ok = False
+    if t_sh > 1 and len(slice_dispatches) < t_sh:
+        print(f"FAIL: only {len(slice_dispatches)} of {t_sh} tree "
+              "slices dispatched")
+        ok = False
+    print(("OK" if ok else "FAILED")
+          + f": {s_sh}x{t_sh} fabric, {len(census_rows)} program(s) "
+          f"censused at exactly one all-reduce, max lnL diff {max_abs:.2e}")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

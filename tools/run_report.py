@@ -206,6 +206,15 @@ def render_programs(out, snap: dict, bench: dict = None) -> None:
             + "  ".join(f"{t}={v}" for t, v in sorted(exceeded.items()))
             + "  dispatches past tolerance (documented divergence — "
               "see ROOFLINE.md 'Compiler-truth bytes')")
+    colls = {k[len("program.collectives."):]: int(v)
+             for k, v in (snap.get("gauges") or {}).items()
+             if k.startswith("program.collectives.")}
+    if colls:
+        out("  collectives                "
+            + "  ".join(f"{f}={v}" for f, v in sorted(colls.items()))
+            + "  (cross-shard ops in the compiled HLO; a fabric "
+              "program carries exactly 1 — the site-axis lnL "
+              "all-reduce)")
 
 
 def render_memory(out, snap: dict) -> None:
@@ -333,6 +342,26 @@ def render_fleet(out, snap: dict, events: list) -> None:
             + ("  " + "  ".join(
                 f"{d}={n}({jobs_per.get(d, 0)}j)" for d, n in lanes)
                if lanes else ""))
+    # The likelihood fabric (ISSUE 17): declared (sites, tree) mesh
+    # shape plus per-tree-slice dispatch/job counters — every slice's
+    # row of each batch, so an idle slice (occupancy rounding) is
+    # visible next to the lanes it replaced.
+    ms = g.get("engine.mesh_site_shards")
+    mt = g.get("engine.mesh_tree_shards") or g.get(
+        "fleet.mesh_tree_shards")
+    slices = [(k.rsplit(".", 1)[-1], int(v))
+              for k, v in sorted(c.items())
+              if k.startswith("fleet.mesh_slice_dispatches.")]
+    if ms or mt or slices:
+        sjobs = {k.rsplit(".", 1)[-1]: int(v)
+                 for k, v in c.items()
+                 if k.startswith("fleet.mesh_slice_jobs.")}
+        out(f"  likelihood fabric          "
+            f"{int(ms or 1)}x{int(mt or 1)} (sites x tree)"
+            f"  batches={int(c.get('fleet.mesh_batches', 0))}"
+            + ("  " + "  ".join(
+                f"{t}={n}({sjobs.get(t, 0)}j)" for t, n in slices)
+               if slices else ""))
     # Rank-level fault domain (leased gangs): lease traffic + the
     # recovery evidence — reaped = a dead rank's in-flight jobs
     # re-served; lost = completions fenced off (exactly-once guard);
@@ -391,6 +420,8 @@ def render_bank(out, snap: dict) -> None:
                              ("cache disabled (no_cache)", "bank.no_cache"),
                              ("sharded in-process residual",
                               "bank.sharded_residual_families"),
+                             ("mesh shardings declared",
+                              "bank.mesh_declared"),
                              ("warm-phase errors", "bank.warm_errors"))
             if c.get(k)]
     exp = [(label, int(c[k]))

@@ -164,6 +164,16 @@ def render_frame(out, workdir: str, beats: list, metrics_path,
                 if counters.get(k))
             if gauges.get("fleet.devices", 0) > 1:
                 fd += f"  lanes={int(gauges['fleet.devices'])}"
+            # Fabric runs: the declared (sites, tree) mesh shape next
+            # to the queue numbers — one glance says which fabric is
+            # serving and how many mesh batches it has dispatched.
+            if gauges.get("fleet.mesh_tree_shards") or \
+                    gauges.get("engine.mesh_site_shards"):
+                fd += (
+                    f"  mesh="
+                    f"{int(gauges.get('engine.mesh_site_shards', 1))}x"
+                    f"{int(gauges.get('fleet.mesh_tree_shards') or gauges.get('engine.mesh_tree_shards', 1))}"
+                    f"({int(counters.get('fleet.mesh_batches', 0))}b)")
             out(f"  fleet{tag}: "
                 f"queue={int(gauges.get('fleet.queue_depth', 0))}  "
                 f"done={int(gauges.get('fleet.jobs_done', 0))}"
