@@ -1212,6 +1212,7 @@ def main(argv=None) -> int:
     from examl_tpu.parallel.launch import (enable_process_tracing,
                                            init_distributed)
     from examl_tpu.resilience import heartbeat as _heartbeat
+    from examl_tpu.resilience import memgov as _memgov
     from examl_tpu.resilience import preempt as _preempt
 
     # One run = one metrics record: callers invoking main() repeatedly in
@@ -1225,6 +1226,7 @@ def main(argv=None) -> int:
     _export_bank.reset()
     _faults.reset()
     _heartbeat.reset()
+    _memgov.reset()
     prior_faults_env = os.environ.get(_faults.ENV_VAR)
     from examl_tpu.obs import ledger as _ledger_mod
     _ledger_mod.reset()
@@ -1329,6 +1331,18 @@ def main(argv=None) -> int:
                    "written; restart with -R to resume (a --supervise "
                    "parent resumes automatically)")
         rc = _preempt.EXIT_PREEMPTED
+        return rc
+    except _memgov.MemoryBudgetExhausted as exc:
+        # The memory governor's in-process ladder (evict + shrink +
+        # halving re-dispatch) is out of moves: exit with the
+        # self-diagnosed allocator-OOM status so a --supervise parent
+        # classifies alloc-oom and restarts with the budget fraction
+        # pinned down (NOT a tier pin — the program tier is fine).
+        obs.ledger_event("run", status="alloc-oom", error=str(exc)[:200])
+        files.info(f"run stopped on device-allocator OOM: {exc} "
+                   "(a --supervise parent retries with a lower "
+                   "EXAML_MEM_BUDGET_FRACTION pin)")
+        rc = _memgov.MemoryBudgetExhausted.exit_code
         return rc
     finally:
         # The metrics snapshot and trace finalize must survive FAILED

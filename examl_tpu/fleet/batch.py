@@ -146,7 +146,19 @@ class BatchEvaluator:
         trade the `fleet.batch_occupancy` gauge records."""
         compiled = self._jpads.setdefault(group_key, set())
         fits = [p for p in compiled if p >= J]
-        jpad = min(fits) if fits else next_pow2(J)
+        if fits:
+            return min(fits)
+        jpad = next_pow2(J)
+        # Minting a pad larger than everything already compiled is jpad
+        # GROWTH — under memory pressure the governor denies it
+        # (counted: the drain's shrunken cap should have kept J inside
+        # the compiled pads) but the pad must still cover J, so the
+        # mint proceeds: admission shrinks future occupancy via
+        # `effective_cap`, it never breaks the batch in hand.
+        if compiled and jpad > max(compiled):
+            from examl_tpu.resilience import memgov
+            if memgov.under_pressure():
+                obs.inc("mem.admission_denials")
         compiled.add(jpad)
         return jpad
 
@@ -317,7 +329,14 @@ class BatchEvaluator:
         return per_part
 
     def _batch_arenas(self, eng, jpad: int):
+        from examl_tpu.resilience import memgov
         rows = eng.n_inner + eng.fast_slack + 1
+        est = (jpad * rows * eng.B * eng.lane * eng.R * eng.K
+               * np.dtype(eng.storage_dtype).itemsize)
+        # Arena provisioning is an admission seam: a denial is counted
+        # evidence (the drain should already have cut the batch), never
+        # a block — the dispatch in hand proceeds.
+        memgov.admit_bytes(est, seam="fleet.batch_arenas")
         clv = jnp.zeros((jpad, rows, eng.B, eng.lane, eng.R, eng.K),
                         eng.storage_dtype)
         scaler = jnp.zeros((jpad, rows, eng.B, eng.lane), jnp.int32)

@@ -46,7 +46,7 @@ from examl_tpu.fleet import lease as _lease
 from examl_tpu.fleet import quarantine
 from examl_tpu.fleet.batch import WEIGHTS_GROUP, batch_eligible
 from examl_tpu.fleet.jobs import JobSpec
-from examl_tpu.resilience import faults
+from examl_tpu.resilience import faults, memgov
 
 
 class FleetDriver:
@@ -478,10 +478,15 @@ class FleetDriver:
             nlanes = len(self.shards) if self.shards is not None else 1
             order = sorted(groups.items(),
                            key=lambda kv: (-len(kv[1]), str(kv[0])))
+            # Memory governor (resilience/memgov.py): under pressure
+            # the drain cuts SMALLER batches — occupancy shrinks
+            # instead of the batch arena OOMing.  Each cut below the
+            # configured cap is a counted admission denial.
+            cap = memgov.effective_cap(self.batch_cap)
             batches: List = []
             for key, members in order:
-                for i in range(0, len(members), self.batch_cap):
-                    batches.append((key, members[i:i + self.batch_cap]))
+                for i in range(0, len(members), cap):
+                    batches.append((key, members[i:i + cap]))
                     if len(batches) >= nlanes:
                         break
                 if len(batches) >= nlanes:
@@ -774,6 +779,17 @@ class FleetDriver:
                 outcome = self._finish_batch(batch, launched)
             except Exception as exc:      # noqa: BLE001
                 outcome = exc
+        oomed = isinstance(outcome, Exception) and memgov.is_oom(outcome)
+        if oomed:
+            # Allocator OOM at the dispatch seam: count it, evict cold
+            # compiled programs + per-topology device caches, then let
+            # the existing halving re-dispatch below retry at a reduced
+            # shape.  Repeated strikes raise MemoryBudgetExhausted from
+            # memgov (→ EXIT_ALLOC_OOM: the supervisor pins the budget
+            # fraction down on restart).
+            memgov.oom_event(outcome, seam="fleet.dispatch")
+            for eng in self.inst.engines.values():
+                memgov.evict_engine(eng)
         consumed: List[int] = []
 
         def evaluate(b, nested=False):
@@ -784,9 +800,14 @@ class FleetDriver:
                 return outcome
             return self._evaluate_batch(b, nested, shard=shard)
 
-        return quarantine.isolate(
+        results = quarantine.isolate(
             batch, evaluate,
             lambda j: self._evaluate_leaf(j, shard=shard))
+        if oomed:
+            # The reduced-shape re-dispatch completed: the evict+shrink
+            # ladder recovered, counted as mem.oom_retries.
+            memgov.oom_recovered()
+        return results
 
     def _apply_results(self, batch: List[JobSpec], results: List) -> None:
         for job, row, err in results:
@@ -862,6 +883,10 @@ class FleetDriver:
             # declaration published just before the dispatch goes
             # stale exactly like a genuine hang inside the batch.
             faults.fire("fleet.job.hang", job=job.job_id)
+            # Synthetic RESOURCE_EXHAUSTED at the dispatch seam: the
+            # raised FaultInjected classifies as OOM in memgov.is_oom,
+            # driving the evict + halving-retry recovery on CPU.
+            faults.fire("mem.oom", job=job.job_id)
         if batch[0].kind == "bootstrap":
             return self._dispatch_bootstrap(batch, nested)
         return self._dispatch_trees(batch, nested, shard)

@@ -137,6 +137,14 @@ class MeshShard(BatchEvaluator):
         else:
             T = self.tree_shards
             jpad = T * next_pow2((J + T - 1) // T)
+            # Same governed-growth accounting as the base evaluator:
+            # minting a pad above every compiled one under pressure is
+            # a counted admission denial (the pad still covers J — the
+            # drain's shrunken cap owns the actual occupancy cut).
+            if compiled and jpad > max(compiled):
+                from examl_tpu.resilience import memgov
+                if memgov.under_pressure():
+                    obs.inc("mem.admission_denials")
             compiled.add(jpad)
         per = max(1, jpad // self.tree_shards)
         obs.inc("fleet.mesh_batches")
@@ -153,7 +161,14 @@ class MeshShard(BatchEvaluator):
                               self._jobs_sh)
 
     def _batch_arenas(self, eng, jpad: int):
+        from examl_tpu.resilience import memgov
         rows = eng.n_inner + eng.fast_slack + 1
+        # Per-device admission: the fabric arena shards over
+        # (tree, ·, sites), so each device holds 1/(T*S) of the stack.
+        est = (jpad * rows * eng.B * eng.lane * eng.R * eng.K
+               * np.dtype(eng.storage_dtype).itemsize
+               // max(1, self.tree_shards * max(1, self.site_shards)))
+        memgov.admit_bytes(est, seam="fleet.mesh_arenas")
         return (self._zeros(
                     (jpad, rows, eng.B, eng.lane, eng.R, eng.K),
                     eng.storage_dtype),

@@ -394,13 +394,31 @@ def model_vs_xla(tier: str, model_bytes: int,
 # -- live HBM telemetry ------------------------------------------------------
 
 
+def host_rss_bytes() -> Optional[int]:
+    """This process's resident set size: psutil when the container has
+    it, else `/proc/self/statm` (field 1 × page size).  None on
+    platforms with neither — the caller counts the missing rung."""
+    try:
+        import psutil                            # type: ignore
+        return int(psutil.Process().memory_info().rss)
+    except Exception:                            # noqa: BLE001 — optional
+        pass
+    try:
+        with open("/proc/self/statm") as fh:
+            pages = int(fh.read().split()[1])
+        return pages * os.sysconf("SC_PAGE_SIZE")
+    except Exception:                            # noqa: BLE001 — non-Linux
+        return None
+
+
 def sample_memory(devices=None, force: bool = False) -> bool:
     """`device.memory_stats()` -> `mem.device.<k>.{in_use,peak,limit}`
     gauges, rate-limited by EXAML_MEM_SAMPLE_S (0 samples every call).
-    Backends without allocator stats (CPU) count
-    `program.analysis_missing.memory_stats` and set nothing — the
-    degradation rung, never an error.  Returns True when a sample was
-    taken."""
+    Backends without allocator stats (CPU) fall back to the HOST
+    resident set (`mem.host.rss` via psutil or /proc/self/statm) so CPU
+    runs still carry real memory telemetry; only when even that rung is
+    missing does `program.analysis_missing.memory_stats` count a truly
+    absent sample.  Returns True when a sample was taken."""
     if not enabled():
         return False
     now = time.monotonic()
@@ -418,7 +436,11 @@ def sample_memory(devices=None, force: bool = False) -> bool:
         for d in devices:
             stats = d.memory_stats()
             if not stats:
-                reg.inc("program.analysis_missing.memory_stats")
+                rss = host_rss_bytes()
+                if rss is None:
+                    reg.inc("program.analysis_missing.memory_stats")
+                else:
+                    reg.gauge("mem.host.rss", int(rss))
                 continue
             k = getattr(d, "id", 0)
             for field, src in (("in_use", "bytes_in_use"),

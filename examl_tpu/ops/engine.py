@@ -1121,7 +1121,16 @@ class LikelihoodEngine:
         # identical avals but different static closures (chunk profile,
         # bucket pair) must never share an artifact.
         from examl_tpu.ops import export_bank
+        from examl_tpu.resilience import memgov
         family = self._cache_family(key)
+        if not memgov.admit_program(family, seam="engine.cache_put"):
+            # Predicted peak exceeds the remaining budget: evict cold
+            # cached executables and per-topology device caches BEFORE
+            # the compile mints more device memory.  Counted
+            # (mem.evictions) — never a silent crash, and the put
+            # proceeds either way: eviction is the reaction, admission
+            # never blocks a needed program.
+            memgov.evict_engine(self)
         guarded = self._guard_first_call(fn, family, key=key)
         fn = export_bank.wrap(fn, guarded, family,
                               (key,) + self._export_identity,

@@ -671,6 +671,17 @@ def wrap(raw_fn, fallback, family: str, static_key,
 
     def _resolve(rkey):
         sig = signature(skey, rkey)
+        # Memory admission before deserialization: loading an exported
+        # executable mints device buffers, so when the governor denies
+        # the family's predicted peak the ladder falls through to the
+        # guarded compile rung — whose cache_put seam evicts cold
+        # programs first instead of stacking a fresh load on a full
+        # device.  (`require` mode outranks the governor: an explicit
+        # zero-compile contract must fail loudly, not quietly compile.)
+        from examl_tpu.resilience import memgov
+        if m != "require" and not memgov.admit_program(
+                family, seam="export_bank.load"):
+            return fallback
         loaded = load(family, sig)
         if loaded is not None:
             def first_hit(*args):

@@ -32,6 +32,14 @@ EXIT_PREEMPTED = 75
 # never succeed, so the supervisor gives up immediately.
 EXIT_USAGE = 2
 
+# Device-allocator OOM the child classified ITSELF (memgov caught a
+# RESOURCE_EXHAUSTED that survived evict+shrink retries and exited
+# cleanly with this status).  Distinct from the OS oom-kill below: the
+# kernel's SIGKILL carries no self-diagnosis, while this code means
+# "HBM budget too high" — the supervisor's restart pins the budget
+# fraction down instead of escalating the tier ladder.
+EXIT_ALLOC_OOM = 76
+
 # classify() causes, in rough severity order.
 CAUSE_OK = "ok"
 CAUSE_PREEMPT = "preempt"          # clean SIGTERM/SIGINT checkpoint+exit
@@ -65,6 +73,12 @@ CAUSE_FLEET_JOB_STUCK = "fleet-job-stuck"  # the fleet heartbeat named an
 CAUSE_OOM_KILL = "oom-kill"        # external SIGKILL: the kernel OOM
                                    # killer is the usual sender when the
                                    # watcher did not kill it itself
+CAUSE_ALLOC_OOM = "alloc-oom"      # device-allocator RESOURCE_EXHAUSTED
+                                   # the child diagnosed itself
+                                   # (EXIT_ALLOC_OOM): retryable with a
+                                   # LOWER memory budget pin, NOT a tier
+                                   # suspect — the program tier is fine,
+                                   # its working set is not
 CAUSE_SIGILL = "sigill"            # mis-featured kernel / cache poisoning
 CAUSE_CRASH = "crash"              # SIGSEGV/SIGBUS/SIGABRT/SIGFPE
 CAUSE_TERMINATED = "terminated"    # SIGTERM that did NOT checkpoint
@@ -76,7 +90,8 @@ CAUSE_RUNNING = "running"
 # is resumable but handled on a separate (non-retry-budget) path.
 RETRYABLE = frozenset({CAUSE_HANG_KILL, CAUSE_OOM_KILL, CAUSE_SIGILL,
                        CAUSE_CRASH, CAUSE_TERMINATED, CAUSE_ERROR,
-                       CAUSE_COLLECTIVE_WEDGE, CAUSE_STRAGGLER})
+                       CAUSE_COLLECTIVE_WEDGE, CAUSE_STRAGGLER,
+                       CAUSE_ALLOC_OOM})
 
 # Causes that indicate the *program tier* (not the environment) may be
 # at fault — these escalate the supervisor's degradation ladder
@@ -122,6 +137,8 @@ def classify(rc: Optional[int], hang_killed: bool = False) -> str:
         return CAUSE_PREEMPT
     if rc == EXIT_USAGE:
         return CAUSE_USAGE
+    if rc == EXIT_ALLOC_OOM:
+        return CAUSE_ALLOC_OOM
     if rc < 0:
         sig = -rc
         if sig == signal.SIGILL:

@@ -9,7 +9,7 @@ failure mode does.
 
 Arming (comma-separated specs, via `EXAML_FAULTS` or `--inject-fault`):
 
-    point[@rank=R][:job=ID][:after=N][:attempt=K][:signal=NAME][:hang[=SECS]][:raise]
+    point[@rank=R][:job=ID][:after=N][:attempt=K][:bytes=N][:signal=NAME][:hang[=SECS]][:raise]
 
 * `@rank=R`   — RANK-TARGETED injection: fire only in the process whose
   gang rank (`EXAML_PROCID`, set per rank by the `--launch` gang
@@ -42,6 +42,8 @@ Registered points (seam → default action):
     fleet.results.write  fleet results-journal append         → raise
     fleet.lease.write  lease-board publish (stage/fsync)      → raise
     fleet.lease.reap   expired-lease reap steal               → raise
+    mem.oom            fleet/engine dispatch, synthetic OOM   → raise
+    mem.pressure       memgov budget clamp (bytes=N)          → flag (sticky)
 
 `flag` points have no side effect here — `fire()` returns True and the
 seam implements the failure (NaN substitution, beat suppression).
@@ -89,6 +91,9 @@ POINTS = {
     "fleet.results.write": "fail a fleet results-journal append",
     "fleet.lease.write": "fail a job-lease publish (stage/fsync seam)",
     "fleet.lease.reap": "fail an expired-lease reap steal mid-flight",
+    "mem.oom": "raise a synthetic RESOURCE_EXHAUSTED at a dispatch seam",
+    "mem.pressure": "clamp the memory governor's budget to N bytes "
+                    "(bytes=N; sticky — pressure persists once applied)",
 }
 
 _DEFAULT_ACTION = {
@@ -99,9 +104,11 @@ _DEFAULT_ACTION = {
     "heartbeat.stall": ("flag", None),
     "fleet.job.poison": ("flag", None),
     "fleet.job.hang": ("hang", 3600.0),
+    "mem.pressure": ("flag", None),
 }
 
-_STICKY = frozenset({"heartbeat.stall", "fleet.job.poison"})
+_STICKY = frozenset({"heartbeat.stall", "fleet.job.poison",
+                     "mem.pressure"})
 
 
 class FaultInjected(RuntimeError):
@@ -166,6 +173,15 @@ def parse_spec(text: str) -> Dict[str, FaultSpec]:
                         f"empty job qualifier in {item!r} "
                         "(expected point:job=ID)")
                 spec.job = val
+            elif key == "bytes":
+                # Value-carrying flag field (mem.pressure): the seam
+                # reads spec.arg as the clamped budget in bytes.
+                try:
+                    spec.arg = int(val)
+                except ValueError:
+                    raise ValueError(
+                        f"bad bytes qualifier {f!r} in {item!r} "
+                        "(expected point:bytes=N)") from None
             else:
                 raise ValueError(f"unknown fault field {f!r} in {item!r}")
         if point in specs:

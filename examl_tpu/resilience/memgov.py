@@ -1,0 +1,415 @@
+"""Per-device HBM pressure governor: admission control over one budget.
+
+The resilience stack (PR3/PR6/PR13) makes wedges, rank deaths, and
+poison jobs cost a resume instead of the run — but an allocator
+``RESOURCE_EXHAUSTED`` still killed the process with no admission
+check, no shrink-and-retry, and no cause-specific supervision.  This
+module closes that gap with the same ladder discipline applied to
+memory, combining three evidence sources into ONE per-device budget:
+
+* the program observatory's compiler-truth predicted peak bytes
+  (``obs/programs.py`` registry rows, ``peak_bytes`` per family);
+* live allocator telemetry (``sample_memory()`` →
+  ``mem.device.<k>.{in_use,peak,limit}`` gauges, with the
+  ``mem.host.rss`` host-RSS fallback on backends without
+  ``memory_stats()``);
+* the engine's arena gauges (``engine.clv_arena_bytes.*``) as the
+  floor when neither allocator nor host telemetry exists.
+
+The budget resolves as: ``EXAML_MEM_BUDGET_BYTES`` (absolute, wins)
+→ ``EXAML_MEM_BUDGET_FRACTION`` × device limit → DEFAULT_FRACTION
+(headroom) × device limit → unlimited when no device limit is known
+(CPU).  The ``mem.pressure`` fault point (``bytes=N``) clamps the
+resolved budget for chaos tests — sticky, so pressure persists for
+the life of the run.
+
+Three admission seams consult it where allocations are minted:
+
+* engine first-call/``cache_put`` — a program whose predicted peak
+  exceeds the remaining budget triggers eviction of cold cached
+  executables and per-topology device caches BEFORE the compile,
+  counted (``mem.evictions``) — never a silent crash;
+* fleet ``_pick_jpad``/drain batch sizing — under pressure jpad
+  growth is denied and the drain cuts smaller batches
+  (``mem.admission_denials``): occupancy shrinks instead of OOM;
+* arena provisioning (fleet batch arenas) — counted denials, never a
+  block.
+
+The recovery half: ``is_oom()`` classifies a caught dispatch
+exception (RESOURCE_EXHAUSTED / XlaRuntimeError-OOM / the injected
+``mem.oom`` fault) → ``mem.oom_events``; the fleet driver evicts and
+re-dispatches through the quarantine halving path
+(``mem.oom_retries``); repeated strikes raise
+:class:`MemoryBudgetExhausted`, which the CLI maps to
+``exitcause.EXIT_ALLOC_OOM`` — the supervisor's restart pins
+``EXAML_MEM_BUDGET_FRACTION`` down instead of escalating the tier
+ladder.
+
+Pure admission math (``resolve_budget``, ``admit_math``,
+``eviction_order``, ``clamp_fraction``) takes plain ints and is
+testable without jax; the gauge-reading conveniences degrade to
+"admit with counter" (``mem.admission_unknown``) whenever an input is
+missing — the governor must never turn absent telemetry into a
+blocked dispatch.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from examl_tpu.resilience import exitcause, faults
+
+ENV_BUDGET_BYTES = "EXAML_MEM_BUDGET_BYTES"
+ENV_BUDGET_FRACTION = "EXAML_MEM_BUDGET_FRACTION"
+
+# Headroom default: XLA's own allocator reserves a slice of HBM, and a
+# dispatch's transient temps land on top of the steady arenas — 90 % of
+# the device limit is the admission ceiling unless overridden.
+# (supervisor.py mirrors this literal — it is jax/obs-free by contract
+# and must not import this module's dependency closure.)
+DEFAULT_FRACTION = 0.90
+
+# Fraction pins are clamped to this floor: a supervisor halving ladder
+# must converge on "tiny but runnable", not zero.
+MIN_FRACTION = 0.05
+
+# Consecutive unrecovered OOM strikes before the governor stops
+# shrinking and escalates to the supervisor as alloc-oom
+# (EXAML_MEM_OOM_STRIKES overrides; 0 = escalate on the first OOM).
+OOM_STRIKE_LIMIT = 3
+ENV_OOM_STRIKES = "EXAML_MEM_OOM_STRIKES"
+
+
+def _strike_limit() -> int:
+    try:
+        return int(os.environ.get(ENV_OOM_STRIKES, "") or OOM_STRIKE_LIMIT)
+    except ValueError:
+        return OOM_STRIKE_LIMIT
+
+_STATE = {"strikes": 0}
+
+
+class MemoryBudgetExhausted(RuntimeError):
+    """Device allocator OOM that survived evict+shrink retries: the
+    in-process ladder is out of moves.  The CLI maps this to
+    ``exitcause.EXIT_ALLOC_OOM`` so a supervisor restart pins the
+    budget fraction down."""
+
+    exit_code = exitcause.EXIT_ALLOC_OOM
+
+
+def reset() -> None:
+    """Clear strike state (one CLI run = one escalation ladder; tests
+    invoking the driver repeatedly must not inherit strikes)."""
+    _STATE["strikes"] = 0
+
+
+# -- pure admission math (no jax, no gauges: unit-testable) ----------------
+
+
+def clamp_fraction(frac: float) -> float:
+    """Budget fractions live in [MIN_FRACTION, 1.0] — a pin ladder
+    halves toward the floor, never to zero; >1 would admit more than
+    the device holds."""
+    return max(MIN_FRACTION, min(1.0, float(frac)))
+
+
+def resolve_budget(limit_bytes: Optional[int],
+                   budget_bytes_env: Optional[str] = None,
+                   fraction_env: Optional[str] = None,
+                   pressure_bytes: Optional[int] = None) -> Optional[int]:
+    """The admission budget in bytes, or None for unlimited.
+
+    Precedence: explicit ``EXAML_MEM_BUDGET_BYTES`` wins; else
+    ``EXAML_MEM_BUDGET_FRACTION`` × device limit; else
+    DEFAULT_FRACTION × device limit; no known device limit (CPU) →
+    unlimited.  A ``mem.pressure`` clamp applies LAST and can only
+    lower the result (or impose one where none existed)."""
+    budget: Optional[int] = None
+    if budget_bytes_env:
+        try:
+            budget = max(0, int(budget_bytes_env))
+        except ValueError:
+            budget = None
+    if budget is None and limit_bytes is not None and limit_bytes > 0:
+        frac = DEFAULT_FRACTION
+        if fraction_env:
+            try:
+                frac = clamp_fraction(float(fraction_env))
+            except ValueError:
+                frac = DEFAULT_FRACTION
+        budget = int(limit_bytes * frac)
+    if pressure_bytes is not None:
+        budget = pressure_bytes if budget is None \
+            else min(budget, pressure_bytes)
+    return budget
+
+
+def admit_math(predicted_bytes: Optional[int], used_bytes: int,
+               budget_bytes: Optional[int]) -> Tuple[bool, Optional[int]]:
+    """(admitted, remaining_after) for one allocation request.
+
+    None budget → always admitted (unlimited, remaining None); None
+    prediction → the CALLER must admit-with-counter (this returns the
+    raw headroom so it can decide)."""
+    if budget_bytes is None:
+        return True, None
+    remaining = budget_bytes - max(0, int(used_bytes))
+    if predicted_bytes is None:
+        return True, remaining
+    return (int(predicted_bytes) <= remaining,
+            remaining - int(predicted_bytes))
+
+
+def eviction_order(entries: Iterable[Tuple[object, float]]) -> List[object]:
+    """Coldest-first eviction ordering: entries are (key, last_use
+    sequence/stamp); lower stamps evict first.  The engine's LRU
+    OrderedDicts already store this order — the helper is the pinned,
+    unit-tested statement of the policy."""
+    return [k for k, _ in sorted(entries, key=lambda kv: kv[1])]
+
+
+# -- gauge-backed budget state (degrades to admit-with-counter) ------------
+
+
+def _pressure_bytes() -> Optional[int]:
+    """The chaos clamp: an armed sticky `mem.pressure` spec carries the
+    budget in spec.arg (`bytes=N`)."""
+    spec = faults.armed("mem.pressure")
+    if spec is None or spec.arg is None:
+        return None
+    try:
+        return int(spec.arg)
+    except (TypeError, ValueError):
+        return None
+
+
+def _gauges() -> Dict[str, float]:
+    try:
+        from examl_tpu import obs
+        return obs.registry().snapshot_light().get("gauges", {})
+    except Exception:                    # noqa: BLE001 — telemetry only
+        return {}
+
+
+def _device_limit(gauges: Dict[str, float]) -> Optional[int]:
+    """Per-device admission limit: the SMALLEST device limit gauge (a
+    replicated fleet arena must fit on every lane)."""
+    limits = [int(v) for k, v in gauges.items()
+              if k.startswith("mem.device.") and k.endswith(".limit")]
+    return min(limits) if limits else None
+
+
+def used_bytes(gauges: Optional[Dict[str, float]] = None) -> int:
+    """Live per-device usage: the BUSIEST device's in_use gauge; CPU
+    runs fall back to the host RSS (`mem.host.rss`), then to the sum of
+    the engines' arena gauges — the floor the governor always knows."""
+    g = _gauges() if gauges is None else gauges
+    in_use = [int(v) for k, v in g.items()
+              if k.startswith("mem.device.") and k.endswith(".in_use")]
+    if in_use:
+        return max(in_use)
+    rss = g.get("mem.host.rss")
+    if rss:
+        return int(rss)
+    return int(sum(v for k, v in g.items()
+                   if k.startswith("engine.clv_arena_bytes.")))
+
+
+def budget_bytes(gauges: Optional[Dict[str, float]] = None) -> Optional[int]:
+    """The resolved budget (env + device limit + pressure clamp), or
+    None for unlimited.  Publishes the `mem.budget_bytes` gauge when a
+    budget exists so report tools can render headroom."""
+    g = _gauges() if gauges is None else gauges
+    budget = resolve_budget(_device_limit(g),
+                            os.environ.get(ENV_BUDGET_BYTES),
+                            os.environ.get(ENV_BUDGET_FRACTION),
+                            _pressure_bytes())
+    if budget is not None:
+        try:
+            from examl_tpu import obs
+            obs.gauge("mem.budget_bytes", budget)
+        except Exception:                # noqa: BLE001 — telemetry only
+            pass
+    return budget
+
+
+def predicted_peak(family: str) -> Optional[int]:
+    """Compiler-truth peak bytes for a program family: the newest
+    observatory row carrying a memory analysis, None when the
+    observatory has no figure (rows mode, analysis missing)."""
+    try:
+        from examl_tpu.obs import programs
+        peak = None
+        for row in programs.table():
+            if row.get("family") == family and \
+                    row.get("peak_bytes") is not None:
+                peak = int(row["peak_bytes"])
+        return peak
+    except Exception:                    # noqa: BLE001 — telemetry only
+        return None
+
+
+def _sample() -> None:
+    """Refresh the live gauges (rate-limited by EXAML_MEM_SAMPLE_S) so
+    admission reads telemetry no staler than the sample interval."""
+    try:
+        from examl_tpu.obs import programs
+        programs.sample_memory()
+    except Exception:                    # noqa: BLE001 — telemetry only
+        pass
+
+
+def under_pressure() -> bool:
+    """True when live usage has reached the budget — the state in which
+    jpad growth is denied and the drain cuts smaller batches."""
+    _sample()
+    g = _gauges()
+    budget = budget_bytes(g)
+    if budget is None:
+        return False
+    return used_bytes(g) >= budget
+
+
+def admit_bytes(predicted: Optional[int], seam: str) -> bool:
+    """One admission decision.  A missing prediction or missing budget
+    admits (counting `mem.admission_unknown` for the former) — the
+    governor turns absent telemetry into evidence, never into a
+    blocked dispatch.  A denial only COUNTS here (`mem.admission_
+    denials`); the seam owns its reaction (evict, shrink, proceed)."""
+    _sample()
+    g = _gauges()
+    budget = budget_bytes(g)
+    if budget is None:
+        return True
+    if predicted is None:
+        inc("mem.admission_unknown")
+        return True
+    ok, _ = admit_math(predicted, used_bytes(g), budget)
+    if not ok:
+        inc("mem.admission_denials")
+        _ledger("mem.admission_denied", seam=seam,
+                predicted_bytes=int(predicted), budget_bytes=budget)
+    return ok
+
+
+def admit_program(family: str, seam: str) -> bool:
+    """Admission for minting one more compiled program of `family`
+    (engine cache_put, export-bank load): predicted peak vs remaining
+    budget."""
+    return admit_bytes(predicted_peak(family), seam)
+
+
+def effective_cap(cap: int) -> int:
+    """The drain's batch cap under the governor: proportional shrink
+    (budget/used, floor 1) when live usage exceeds the budget, the
+    configured cap otherwise.  A cut is a counted admission denial —
+    occupancy shrinks instead of OOM."""
+    cap = max(1, int(cap))
+    _sample()
+    g = _gauges()
+    budget = budget_bytes(g)
+    if budget is None:
+        return cap
+    used = used_bytes(g)
+    if used <= budget or used <= 0:
+        return cap
+    shrunk = max(1, int(cap * budget / used))
+    if shrunk >= cap:
+        shrunk = cap - 1 if cap > 1 else 1
+    if shrunk < cap:
+        inc("mem.admission_denials")
+        _ledger("mem.cap_shrunk", cap=cap, effective=shrunk,
+                used_bytes=used, budget_bytes=budget)
+    return shrunk
+
+
+# -- eviction (the engine's cold cached executables) -----------------------
+
+
+def evict_engine(engine, keep: int = 1) -> int:
+    """Evict cold compiled programs and per-topology device caches from
+    one engine, coldest-first, keeping the `keep` hottest shared-cache
+    entries.  Returns the eviction count (also counted as
+    `mem.evictions`).  Structure caches are content-keyed (staleness
+    impossible) so clearing them is memory hygiene by construction."""
+    n = 0
+    cache = getattr(engine, "_fast_jit_cache", None)
+    if cache:
+        while len(cache) > max(0, keep):
+            cache.popitem(last=False)
+            n += 1
+    for attr in ("_sched_cache", "_universal_tables", "_grad_structs"):
+        side = getattr(engine, attr, None)
+        if side:
+            n += len(side)
+            side.clear()
+    if n:
+        inc("mem.evictions", n)
+        _ledger("mem.evicted", count=n)
+    return n
+
+
+# -- OOM classification + escalation ---------------------------------------
+
+_OOM_MARKERS = ("resource_exhausted", "out of memory", "outofmemory",
+                "allocation failure", "failed to allocate")
+
+
+def is_oom(exc: Optional[BaseException]) -> bool:
+    """Is this caught dispatch exception a device-allocator OOM?
+    Matches XLA's RESOURCE_EXHAUSTED/XlaRuntimeError-OOM message forms
+    and the injected `mem.oom` fault (FaultInjected carries the point
+    name)."""
+    if exc is None or not isinstance(exc, BaseException):
+        return False
+    text = str(exc)
+    if isinstance(exc, faults.FaultInjected):
+        return "mem.oom" in text
+    low = text.lower()
+    return any(m in low for m in _OOM_MARKERS)
+
+
+def oom_event(exc: BaseException, seam: str) -> None:
+    """Record one classified OOM at a dispatch seam (`mem.oom_events`)
+    and advance the strike ladder; past OOM_STRIKE_LIMIT consecutive
+    unrecovered strikes raise MemoryBudgetExhausted — the supervisor's
+    alloc-oom restart (budget-fraction pin) takes over from in-process
+    shrinking."""
+    _STATE["strikes"] += 1
+    inc("mem.oom_events")
+    _ledger("mem.oom", seam=seam, strikes=_STATE["strikes"],
+            error=str(exc)[:200])
+    if _STATE["strikes"] > _strike_limit():
+        raise MemoryBudgetExhausted(
+            f"device allocator OOM at {seam} persisted through "
+            f"{_STATE['strikes']} evict+shrink retries: {exc}") from exc
+
+
+def oom_recovered() -> None:
+    """A dispatch completed after an OOM: the evict+shrink ladder
+    worked, so the strike counter resets (`mem.oom_retries` counts the
+    recovery)."""
+    if _STATE["strikes"]:
+        _STATE["strikes"] = 0
+        inc("mem.oom_retries")
+
+
+# -- obs shims (memgov stays importable before obs is configured) ----------
+
+
+def inc(name: str, v: float = 1) -> None:
+    try:
+        from examl_tpu import obs
+        obs.inc(name, v)
+    except Exception:                    # noqa: BLE001 — telemetry only
+        pass
+
+
+def _ledger(event: str, **fields) -> None:
+    try:
+        from examl_tpu import obs
+        obs.ledger_event(event, **fields)
+    except Exception:                    # noqa: BLE001 — telemetry only
+        pass

@@ -251,6 +251,12 @@ class Supervisor:
         self._hang_attempts: Dict[str, int] = {}
         self._last_stuck_jobs: List[str] = []
         self._job_stuck_kills = 0
+        # Memory fault domain: an alloc-oom exit (EXIT_ALLOC_OOM — the
+        # child's memory governor gave up on evict+shrink) pins the
+        # admission budget fraction DOWN for every later attempt,
+        # halving toward the floor — the tier ladder's discipline
+        # applied to memory instead of program tiers.
+        self._mem_fraction_pin: Optional[float] = None
 
     # -- bookkeeping --------------------------------------------------------
 
@@ -258,8 +264,12 @@ class Supervisor:
         self.counters[name] = self.counters.get(name, 0) + v
 
     def _pins(self) -> Dict[str, str]:
-        return dict(DEGRADE_LADDER[min(self.degrade_level,
+        pins = dict(DEGRADE_LADDER[min(self.degrade_level,
                                        len(DEGRADE_LADDER) - 1)])
+        if self._mem_fraction_pin is not None:
+            pins["EXAML_MEM_BUDGET_FRACTION"] = \
+                f"{self._mem_fraction_pin:.4g}"
+        return pins
 
     def _attempt_argv(self) -> List[str]:
         argv = list(self.base_argv)
@@ -273,6 +283,25 @@ class Supervisor:
     # policies can never drift):
 
     def _escalate(self, cause: str) -> None:
+        if cause == exitcause.CAUSE_ALLOC_OOM:
+            # The child diagnosed a device-allocator OOM itself: the
+            # program tier is fine, its working set is not — halve the
+            # admission budget fraction instead of degrading the tier.
+            # 0.90 mirrors memgov.DEFAULT_FRACTION, 0.05 its floor
+            # (this parent is jax/obs-free by contract and must not
+            # import memgov's dependency closure).
+            cur = self._mem_fraction_pin
+            if cur is None:
+                try:
+                    cur = float(os.environ.get(
+                        "EXAML_MEM_BUDGET_FRACTION") or 0.90)
+                except ValueError:
+                    cur = 0.90
+            self._mem_fraction_pin = max(0.05, cur / 2.0)
+            self._inc("resilience.mem_budget_pins")
+            _ledger.event("supervisor.mem_budget_pin",
+                          fraction=self._mem_fraction_pin)
+            return
         if cause in exitcause.TIER_SUSPECT:
             # The step guarantees the scan-tier FLOOR (the ladder's
             # last rung) is reached within the configured retry

@@ -1,0 +1,438 @@
+"""HBM pressure governor (ISSUE 18).
+
+One per-device admission budget (compiler-truth predicted peaks, live
+`sample_memory()` telemetry with the `mem.host.rss` fallback, engine
+arena gauges) consulted where allocations are minted; a classified
+allocator OOM at a dispatch seam costs an evict + halving retry
+(`mem.oom_retries`), never the run; repeated strikes escalate as the
+`alloc-oom` exit cause whose supervised restart pins
+`EXAML_MEM_BUDGET_FRACTION` down instead of degrading the tier; a
+forced tiny budget (`mem.pressure:bytes=N`) provably shrinks batch
+occupancy (`mem.admission_denials`) instead of raising.
+"""
+
+import json
+import os
+import subprocess
+import sys
+from collections import OrderedDict
+from types import SimpleNamespace
+
+import pytest
+
+from examl_tpu.instance import PhyloInstance
+
+from tests.conftest import correlated_dna
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# -- fault grammar: bytes=N + the mem.* points --------------------------------
+
+
+def test_fault_grammar_bytes_qualifier():
+    from examl_tpu.resilience import faults
+    specs = faults.parse_spec("mem.pressure:bytes=1024")
+    assert specs["mem.pressure"].action == "flag"
+    assert specs["mem.pressure"].arg == 1024
+    specs = faults.parse_spec("mem.oom:after=2:job=j1")
+    assert specs["mem.oom"].after == 2
+    assert specs["mem.oom"].job == "j1"
+    assert specs["mem.oom"].action == "raise"
+    with pytest.raises(ValueError, match="bytes"):
+        faults.parse_spec("mem.pressure:bytes=lots")
+
+
+def test_mem_pressure_fault_is_sticky(monkeypatch):
+    """Pressure persists once applied: the clamp must squeeze every
+    subsequent admission decision, not just the first check."""
+    from examl_tpu.resilience import faults
+    monkeypatch.setenv("EXAML_FAULTS", "mem.pressure:bytes=64")
+    faults.reset()
+    for _ in range(3):
+        spec = faults.armed("mem.pressure")
+        assert spec is not None and spec.arg == 64
+    faults.reset()
+
+
+# -- pure admission math ------------------------------------------------------
+
+
+def test_clamp_fraction_headroom_bounds():
+    from examl_tpu.resilience import memgov
+    assert memgov.clamp_fraction(0.5) == 0.5
+    assert memgov.clamp_fraction(2.0) == 1.0        # never over the device
+    assert memgov.clamp_fraction(0.0) == memgov.MIN_FRACTION
+    assert memgov.clamp_fraction(-3.0) == memgov.MIN_FRACTION
+
+
+def test_resolve_budget_precedence():
+    from examl_tpu.resilience import memgov
+    # default headroom fraction of the device limit
+    assert memgov.resolve_budget(1000) == 900
+    # explicit fraction
+    assert memgov.resolve_budget(1000, fraction_env="0.5") == 500
+    # absolute bytes WIN over the fraction
+    assert memgov.resolve_budget(1000, budget_bytes_env="123",
+                                 fraction_env="0.5") == 123
+    # no device limit (CPU) -> unlimited
+    assert memgov.resolve_budget(None) is None
+    assert memgov.resolve_budget(0) is None
+    # pressure clamp applies LAST and only lowers (or imposes)
+    assert memgov.resolve_budget(1000, pressure_bytes=7) == 7
+    assert memgov.resolve_budget(None, pressure_bytes=7) == 7
+    assert memgov.resolve_budget(1000, budget_bytes_env="50",
+                                 pressure_bytes=7000) == 50
+    # garbage env values fall back, never raise
+    assert memgov.resolve_budget(1000, budget_bytes_env="banana") == 900
+    assert memgov.resolve_budget(1000, fraction_env="banana") == 900
+    # fraction headroom clamp
+    assert memgov.resolve_budget(1000, fraction_env="9.0") == 1000
+
+
+def test_admit_math_budget_accounting():
+    from examl_tpu.resilience import memgov
+    # unlimited budget admits everything
+    assert memgov.admit_math(10**12, 0, None) == (True, None)
+    # fits: admitted, remaining decremented
+    assert memgov.admit_math(100, 50, 200) == (True, 50)
+    # exact fit admits
+    assert memgov.admit_math(150, 50, 200) == (True, 0)
+    # over budget: denied, deficit reported
+    assert memgov.admit_math(100, 150, 200) == (False, -50)
+    # unknown prediction: admitted, raw headroom returned (the caller
+    # counts mem.admission_unknown)
+    assert memgov.admit_math(None, 0, 100) == (True, 100)
+
+
+def test_eviction_order_coldest_first():
+    from examl_tpu.resilience import memgov
+    assert memgov.eviction_order([("a", 3), ("b", 1), ("c", 2)]) \
+        == ["b", "c", "a"]
+    assert memgov.eviction_order([]) == []
+
+
+# -- corrupt-input matrix: absent telemetry admits with a counter -------------
+
+
+def test_governor_absent_telemetry_never_blocks(monkeypatch):
+    from examl_tpu import obs
+    from examl_tpu.resilience import memgov
+    monkeypatch.delenv(memgov.ENV_BUDGET_BYTES, raising=False)
+    monkeypatch.delenv(memgov.ENV_BUDGET_FRACTION, raising=False)
+    # no device gauges, no env, no pressure -> unlimited
+    assert memgov.budget_bytes({}) is None
+    assert memgov.used_bytes({}) == 0
+    # arena gauges are the usage floor when no allocator/host telemetry
+    assert memgov.used_bytes({"engine.clv_arena_bytes.a": 10,
+                              "engine.clv_arena_bytes.b": 5}) == 15
+    # host RSS outranks the arena floor; busiest device outranks both
+    assert memgov.used_bytes({"mem.host.rss": 99,
+                              "engine.clv_arena_bytes.a": 10}) == 99
+    assert memgov.used_bytes({"mem.device.0.in_use": 7,
+                              "mem.device.1.in_use": 9,
+                              "mem.host.rss": 99}) == 9
+    # absent cost analysis for a family -> None, and admit_bytes turns
+    # that into admit-with-counter (never a block)
+    assert memgov.predicted_peak("no.such.family") is None
+    reg = obs.registry()
+    u0 = reg.counter("mem.admission_unknown")
+    monkeypatch.setenv(memgov.ENV_BUDGET_BYTES, "100")
+    assert memgov.admit_bytes(None, seam="test.unknown") is True
+    assert reg.counter("mem.admission_unknown") == u0 + 1
+    # a huge budget admits a real prediction without any counter
+    d0 = reg.counter("mem.admission_denials")
+    monkeypatch.setenv(memgov.ENV_BUDGET_BYTES, str(10**15))
+    assert memgov.admit_bytes(1024, seam="test.fits") is True
+    assert reg.counter("mem.admission_denials") == d0
+    # a 1-byte budget denies (counted) but still only COUNTS here —
+    # the seam owns the reaction
+    monkeypatch.setenv(memgov.ENV_BUDGET_BYTES, "1")
+    monkeypatch.setenv("EXAML_MEM_SAMPLE_S", "0")
+    assert memgov.admit_bytes(10**9, seam="test.denied") is False
+    assert reg.counter("mem.admission_denials") == d0 + 1
+
+
+def test_effective_cap_shrinks_proportionally(monkeypatch):
+    from examl_tpu import obs
+    from examl_tpu.resilience import memgov
+    monkeypatch.setenv("EXAML_MEM_SAMPLE_S", "0")
+    # no budget -> the configured cap stands
+    monkeypatch.delenv(memgov.ENV_BUDGET_BYTES, raising=False)
+    monkeypatch.delenv(memgov.ENV_BUDGET_FRACTION, raising=False)
+    assert memgov.effective_cap(8) == 8
+    # usage over budget -> proportional shrink, floor 1, counted
+    reg = obs.registry()
+    d0 = reg.counter("mem.admission_denials")
+    monkeypatch.setenv(memgov.ENV_BUDGET_BYTES, "1")
+    assert memgov.effective_cap(8) == 1
+    assert reg.counter("mem.admission_denials") == d0 + 1
+    assert memgov.effective_cap(1) == 1               # floor holds
+
+
+# -- eviction: cold compiled programs + per-topology caches -------------------
+
+
+def test_evict_engine_lru_tail_first_and_side_caches():
+    from examl_tpu import obs
+    from examl_tpu.resilience import memgov
+    eng = SimpleNamespace(
+        _fast_jit_cache=OrderedDict([("cold", 1), ("warm", 2), ("hot", 3)]),
+        _sched_cache={"s": 1},
+        _universal_tables={"u": 1, "v": 2},
+        _grad_structs={},
+    )
+    reg = obs.registry()
+    e0 = reg.counter("mem.evictions")
+    n = memgov.evict_engine(eng, keep=1)
+    # coldest-first: the LRU head goes, the hottest entry survives
+    assert list(eng._fast_jit_cache) == ["hot"]
+    assert eng._sched_cache == {} and eng._universal_tables == {}
+    assert n == 2 + 1 + 2
+    assert reg.counter("mem.evictions") == e0 + n
+    # at the keep floor a second evict is inert: nothing to drop
+    e1 = reg.counter("mem.evictions")
+    assert memgov.evict_engine(eng, keep=1) == 0
+    assert list(eng._fast_jit_cache) == ["hot"]
+    assert reg.counter("mem.evictions") == e1
+
+
+# -- OOM classification + the strike ladder -----------------------------------
+
+
+def test_is_oom_classifier():
+    from examl_tpu.resilience import faults, memgov
+    assert memgov.is_oom(RuntimeError("RESOURCE_EXHAUSTED: out of memory"))
+    assert memgov.is_oom(RuntimeError("Out of memory allocating 4096 bytes"))
+    assert memgov.is_oom(RuntimeError("Failed to allocate device buffer"))
+    assert memgov.is_oom(faults.FaultInjected("injected fault at mem.oom"))
+    assert not memgov.is_oom(RuntimeError("boom"))
+    assert not memgov.is_oom(
+        faults.FaultInjected("injected fault at fleet.dispatch"))
+    assert not memgov.is_oom(None)
+
+
+def test_oom_strike_ladder_escalates_then_resets(monkeypatch):
+    from examl_tpu import obs
+    from examl_tpu.resilience import exitcause, memgov
+    monkeypatch.setenv(memgov.ENV_OOM_STRIKES, "2")
+    memgov.reset()
+    err = RuntimeError("RESOURCE_EXHAUSTED")
+    memgov.oom_event(err, seam="test")                # strike 1
+    memgov.oom_event(err, seam="test")                # strike 2
+    with pytest.raises(memgov.MemoryBudgetExhausted) as ei:
+        memgov.oom_event(err, seam="test")            # past the limit
+    assert ei.value.exit_code == exitcause.EXIT_ALLOC_OOM
+    # recovery resets the ladder and counts the retry that worked
+    memgov.reset()
+    reg = obs.registry()
+    r0 = reg.counter("mem.oom_retries")
+    memgov.oom_event(err, seam="test")
+    memgov.oom_recovered()
+    assert reg.counter("mem.oom_retries") == r0 + 1
+    memgov.oom_event(err, seam="test")                # ladder restarted
+    memgov.oom_event(err, seam="test")
+    memgov.reset()
+    # strikes=0 escalates on the FIRST OOM (the supervised e2e hook)
+    monkeypatch.setenv(memgov.ENV_OOM_STRIKES, "0")
+    with pytest.raises(memgov.MemoryBudgetExhausted):
+        memgov.oom_event(err, seam="test")
+    memgov.reset()
+
+
+def test_exitcause_alloc_oom_distinct_from_oom_kill():
+    """alloc-oom (the child self-classified a device-allocator OOM) is
+    a DIFFERENT cause than oom-kill (the OS killed us): the former pins
+    the memory budget, the latter the tier ladder."""
+    from examl_tpu.resilience import exitcause
+    assert exitcause.EXIT_ALLOC_OOM == 76
+    cause = exitcause.classify(exitcause.EXIT_ALLOC_OOM)
+    assert cause == exitcause.CAUSE_ALLOC_OOM == "alloc-oom"
+    assert cause != exitcause.CAUSE_OOM_KILL
+    assert cause in exitcause.RETRYABLE
+    assert cause not in exitcause.TIER_SUSPECT
+
+
+# -- supervisor: alloc-oom pins the budget fraction, not the tier -------------
+
+
+def test_supervisor_alloc_oom_pins_budget_fraction(tmp_path, monkeypatch):
+    """The non-slow representative of the supervised alloc-oom
+    escalation: _escalate(alloc-oom) halves the budget-fraction pin
+    into the restart env and does NOT touch the tier ladder."""
+    from examl_tpu.resilience import exitcause
+    from examl_tpu.resilience.supervisor import Supervisor
+    monkeypatch.delenv("EXAML_MEM_BUDGET_FRACTION", raising=False)
+    sup = Supervisor([sys.executable, "-c", "pass"], str(tmp_path), "PIN")
+    level0 = sup.degrade_level
+    sup._escalate(exitcause.CAUSE_ALLOC_OOM)
+    assert sup._pins()["EXAML_MEM_BUDGET_FRACTION"] == "0.45"
+    sup._escalate(exitcause.CAUSE_ALLOC_OOM)
+    assert sup._pins()["EXAML_MEM_BUDGET_FRACTION"] == "0.225"
+    assert sup.degrade_level == level0            # tier ladder untouched
+    assert sup.counters["resilience.mem_budget_pins"] == 2
+    for _ in range(10):                           # the ladder has a floor
+        sup._escalate(exitcause.CAUSE_ALLOC_OOM)
+    assert sup._pins()["EXAML_MEM_BUDGET_FRACTION"] == "0.05"
+    # an env-inherited pin (restart of a restarted run) halves FROM it
+    monkeypatch.setenv("EXAML_MEM_BUDGET_FRACTION", "0.2")
+    sup2 = Supervisor([sys.executable, "-c", "pass"], str(tmp_path), "PIN2")
+    sup2._escalate(exitcause.CAUSE_ALLOC_OOM)
+    assert sup2._pins()["EXAML_MEM_BUDGET_FRACTION"] == "0.1"
+
+
+# -- fleet chaos e2e ----------------------------------------------------------
+
+
+def _fast_policy(max_attempts=2):
+    from examl_tpu.fleet.quarantine import JobFaultPolicy
+    return JobFaultPolicy(max_attempts=max_attempts, backoff_base=0.01,
+                          backoff_cap=0.05)
+
+
+def test_fleet_oom_chaos_16_jobs_degrade_not_die(tmp_path, monkeypatch):
+    """ISSUE 18 acceptance: a 16-job fleet with `mem.oom:after=2`
+    completes with every `job.done` exactly once, per-job lnL
+    BIT-IDENTICAL to a clean run, `mem.oom_retries` > 0 and ZERO
+    quarantines — the OOM cost an evict + halving retry, not a job and
+    not a run-level restart."""
+    from examl_tpu import obs
+    from examl_tpu.fleet import quarantine
+    from examl_tpu.fleet.driver import FleetDriver
+    from examl_tpu.fleet.jobs import make_jobs
+    from examl_tpu.resilience import faults, memgov
+    data = correlated_dna(10, 160, seed=4)
+    inst = PhyloInstance(data)
+    clean_drv = FleetDriver(inst, batch_cap=4)
+    clean_out = clean_drv.run(make_jobs("start", 16, 7))
+    assert all(j.done and not j.failed for j in clean_out)
+    clean = {j.job_id: j.lnl for j in clean_out}
+    monkeypatch.setenv("EXAML_FAULTS", "mem.oom:after=2")
+    faults.reset()
+    memgov.reset()
+    jr = quarantine.ResultsJournal(str(tmp_path / "journal"))
+    drv = FleetDriver(PhyloInstance(data), batch_cap=4,
+                      policy=_fast_policy(), journal=jr)
+    reg = obs.registry()
+    q0 = reg.counter("fleet.quarantined")
+    o0 = reg.counter("mem.oom_events")
+    r0 = reg.counter("mem.oom_retries")
+    out = drv.run(make_jobs("start", 16, 7))
+    by = {j.job_id: j for j in out}
+    assert len(by) == 16
+    assert all(j.done and not j.failed for j in out)
+    assert reg.counter("fleet.quarantined") == q0         # zero quarantines
+    assert reg.counter("mem.oom_events") == o0 + 1
+    assert reg.counter("mem.oom_retries") == r0 + 1       # recovered
+    for jid, lnl in clean.items():
+        assert by[jid].lnl == lnl, jid                    # BITWISE
+    # every job.done exactly once (the journal is the durable record)
+    recs = [r for r in jr.read() if r["done"] and not r["failed"]]
+    ids = [r["job_id"] for r in recs]
+    assert sorted(ids) == sorted(set(ids)) and len(ids) == 16
+    faults.reset()
+    memgov.reset()
+    # CI oom-chaos-smoke artifact: the metrics snapshot of this run
+    out_path = os.environ.get("EXAML_OOM_SMOKE_OUT")
+    if out_path:
+        snap = obs.registry().snapshot_light()
+        with open(out_path, "w") as fh:
+            json.dump(snap, fh, indent=1, sort_keys=True, default=str)
+
+
+def test_mem_pressure_tiny_budget_shrinks_occupancy(monkeypatch):
+    """ISSUE 18 acceptance: a forced tiny budget (`mem.pressure`)
+    provably SHRINKS batch occupancy — `mem.admission_denials` > 0 and
+    the drain cuts solo batches — instead of raising."""
+    from examl_tpu import obs
+    from examl_tpu.fleet.driver import FleetDriver
+    from examl_tpu.fleet.jobs import make_jobs
+    from examl_tpu.resilience import faults, memgov
+    monkeypatch.setenv("EXAML_FAULTS", "mem.pressure:bytes=1")
+    monkeypatch.setenv("EXAML_MEM_SAMPLE_S", "0")
+    faults.reset()
+    memgov.reset()
+    data = correlated_dna(8, 120, seed=2)
+    inst = PhyloInstance(data)
+    drv = FleetDriver(inst, batch_cap=8)
+    dispatched = []
+    orig = drv._dispatch_round
+    drv._dispatch_round = lambda assignments: (dispatched.extend(
+        [j.job_id for j in b] for _, b in assignments),
+        orig(assignments))[1]
+    reg = obs.registry()
+    d0 = reg.counter("mem.admission_denials")
+    out = drv.run(make_jobs("start", 6, 3))
+    assert all(j.done and not j.failed for j in out)      # degrade, not die
+    assert reg.counter("mem.admission_denials") > d0
+    # the 8-cap drain was squeezed to solo batches by the 1-byte budget
+    assert dispatched and all(len(b) == 1 for b in dispatched)
+    faults.reset()
+    memgov.reset()
+
+
+def test_oom_strikes_exhausted_escalates_from_dispatch(monkeypatch):
+    """When the evict+shrink ladder is out of moves (strike limit 0),
+    the dispatch seam raises MemoryBudgetExhausted — the CLI maps it to
+    exit 76 and a supervising parent pins the budget fraction down."""
+    from examl_tpu.fleet.driver import FleetDriver
+    from examl_tpu.fleet.jobs import make_jobs
+    from examl_tpu.resilience import faults, memgov
+    monkeypatch.setenv("EXAML_FAULTS", "mem.oom:after=2")
+    monkeypatch.setenv(memgov.ENV_OOM_STRIKES, "0")
+    faults.reset()
+    memgov.reset()
+    data = correlated_dna(8, 120, seed=2)
+    drv = FleetDriver(PhyloInstance(data), batch_cap=4,
+                      policy=_fast_policy())
+    with pytest.raises(memgov.MemoryBudgetExhausted):
+        drv.run(make_jobs("start", 4, 3))
+    faults.reset()
+    memgov.reset()
+
+
+# -- supervised alloc-oom escalation (subprocess) -----------------------------
+
+
+def _chaos_env(**extra):
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=os.pathsep.join(
+                   [REPO, os.environ.get("PYTHONPATH", "")]))
+    for k in ("EXAML_FAULTS", "EXAML_HEARTBEAT_FILE",
+              "EXAML_FLEET_HANG_ATTEMPTS", "EXAML_RESTART_COUNT",
+              "EXAML_MEM_OOM_STRIKES", "EXAML_MEM_BUDGET_FRACTION"):
+        env.pop(k, None)
+    env.update(extra)
+    return env
+
+
+@pytest.mark.slow
+def test_supervised_alloc_oom_restart_pins_budget(tmp_path):
+    """The full escalation: strikes=0 turns the injected OOM into exit
+    76, the supervisor classifies alloc-oom and restarts with an
+    EXAML_MEM_BUDGET_FRACTION pin (no tier degradation), and the resumed
+    fleet completes every job."""
+    from examl_tpu.io.bytefile import write_bytefile
+    data = correlated_dna(8, 120, seed=0)
+    bf = str(tmp_path / "a.binary")
+    write_bytefile(bf, data)
+    env = _chaos_env(EXAML_MEM_OOM_STRIKES="0")
+    m = str(tmp_path / "m.json")
+    out = subprocess.run(
+        [sys.executable, "-m", "examl_tpu.cli.main", "-s", bf, "-n",
+         "QOOM", "-N", "8", "--fleet-batch", "4",
+         "-w", str(tmp_path), "--metrics", m,
+         "--supervise", "--supervise-backoff", "0.2",
+         "--inject-fault", "mem.oom:after=2"],
+        env=env, cwd=REPO, capture_output=True, text=True, timeout=480)
+    assert out.returncode == 0, out.stdout[-3000:] + out.stderr[-2000:]
+    rows = {}
+    for line in open(tmp_path / "ExaML_fleet.QOOM"):
+        if not line.startswith("#"):
+            rows[line.split()[0]] = line.split()[6]
+    assert len(rows) == 8 and all(v == "done" for v in rows.values())
+    snap = json.load(open(m))
+    c = snap["counters"]
+    assert c.get("resilience.exits.alloc_oom", 0) >= 1
+    assert c.get("resilience.mem_budget_pins", 0) >= 1
+    assert c.get("resilience.restarts", 0) >= 1

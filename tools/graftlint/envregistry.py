@@ -133,6 +133,20 @@ ENV_REGISTRY = {
         "doc": "readme",
         "note": "min seconds between device memory_stats() samples "
                 "(default 5; 0 samples every call)."},
+    "EXAML_MEM_BUDGET_BYTES": {
+        "doc": "readme",
+        "note": "absolute memory-governor admission budget in bytes "
+                "(resilience/memgov.py; wins over the fraction)."},
+    "EXAML_MEM_BUDGET_FRACTION": {
+        "doc": "readme",
+        "note": "memory-governor budget as a fraction of the device "
+                "limit (default 0.90 headroom; the supervisor's "
+                "alloc-oom restart pins it down by halving)."},
+    "EXAML_MEM_OOM_STRIKES": {
+        "doc": "readme",
+        "note": "consecutive unrecovered allocator-OOM strikes before "
+                "the governor escalates to the supervisor as "
+                "alloc-oom (default 3; 0 escalates on the first)."},
     "EXAML_DRIFT_TOL_PCT": {
         "doc": "readme",
         "note": "model-vs-XLA bytes drift tolerance in percent "

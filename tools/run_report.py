@@ -236,7 +236,19 @@ def render_memory(out, snap: dict) -> None:
                 if k.startswith("engine.clv_arena_bytes."))
     c = snap.get("counters") or {}
     missing = int(c.get("program.analysis_missing.memory_stats", 0))
-    if not devs and not arena:
+    rss = g.get("mem.host.rss")
+    budget = g.get("mem.budget_bytes")
+    # Memory-governor evidence (resilience/memgov.py): admission and
+    # recovery counters next to the budget they enforced.
+    gov = [(label, int(c.get(k, 0)))
+           for label, k in (("admission denials", "mem.admission_denials"),
+                            ("admissions unknown", "mem.admission_unknown"),
+                            ("evictions", "mem.evictions"),
+                            ("oom events", "mem.oom_events"),
+                            ("oom retries (recovered)", "mem.oom_retries"))
+           if c.get(k)]
+    if not devs and not arena and not rss and not gov \
+            and budget is None:
         return
     out("")
     out("Device memory (live allocator stats vs modeled arena):")
@@ -252,8 +264,25 @@ def render_memory(out, snap: dict) -> None:
         out(line)
     if not devs:
         out(f"  CLV arena (modeled)        {_fmt_bytes(arena)}"
+            + (f"  host RSS {_fmt_bytes(rss)}" if rss else "")
             + (f"  (no allocator stats on this backend; "
                f"memory_stats degraded x{missing})" if missing else ""))
+    if budget is not None or gov:
+        out("")
+        out("Memory governor (admission budget, resilience/memgov.py):")
+        if budget is not None:
+            used = None
+            for d in devs.values():
+                if d.get("in_use"):
+                    used = max(used or 0, d["in_use"])
+            if used is None:
+                used = rss or arena or None
+            out(f"  budget                     {_fmt_bytes(budget)}"
+                + (f"  (live usage {_fmt_bytes(used)} = "
+                   f"{100.0 * used / budget:.0f}%)"
+                   if used and budget else ""))
+        for label, v in gov:
+            out(f"  {label:26s} {v}")
 
 
 # -- timers / histogram quantiles -------------------------------------------

@@ -212,7 +212,9 @@ def render_frame(out, workdir: str, beats: list, metrics_path,
                   if k.startswith("program.model_drift_pct.")}
         nprog = int(gauges.get("program.count", 0)) \
             or len(snap.get("programs") or [])
-        if mem or arena or nprog:
+        rss = gauges.get("mem.host.rss")
+        budget = gauges.get("mem.budget_bytes")
+        if mem or arena or nprog or rss or budget:
             def _mb(v):
                 if not v:
                     return "-"
@@ -221,14 +223,34 @@ def render_frame(out, workdir: str, beats: list, metrics_path,
             parts = [f"d{d} {_mb(m.get('in_use'))}/"
                      f"{_mb(m.get('limit'))} peak={_mb(m.get('peak'))}"
                      for d, m in sorted(mem.items())]
-            if not parts and arena:
+            if not parts and rss:
+                # CPU fallback telemetry: the host resident set stands
+                # in for allocator stats (obs/programs.py).
+                parts = [f"rss {_mb(rss)}"]
+            elif not parts and arena:
                 parts = ["(no allocator stats on this backend)"]
+            # Memory-governor tail (resilience/memgov.py): budget +
+            # admission/OOM-recovery evidence, rendered only when the
+            # governor acted — a quiet run keeps its one-line view.
+            counters = snap.get("counters") or {}
+            govtail = "".join(
+                f"  {label}={int(counters.get(k, 0))}"
+                for label, k in (("denied", "mem.admission_denials"),
+                                 ("unk", "mem.admission_unknown"),
+                                 ("evict", "mem.evictions"),
+                                 ("oom", "mem.oom_events"),
+                                 ("oom_retry", "mem.oom_retries"))
+                if counters.get(k))
+            if budget or govtail:
+                govtail = (f"  mem=budget:{_mb(budget)}" if budget
+                           else "  mem=gov") + govtail
             out(f"  memory{tag}: " + "  ".join(parts)
                 + (f"  arena={_mb(arena)}" if arena else "")
                 + (f"  programs={nprog}" if nprog else "")
                 + ("  drift=" + ",".join(
                     f"{t}:{v:.0f}%" for t, v in sorted(drifts.items()))
-                   if drifts else ""))
+                   if drifts else "")
+                + govtail)
         if not rows and snap:
             out(f"  metrics{tag}: "
                 f"{len(snap.get('counters') or {})} counters, "
